@@ -1,0 +1,36 @@
+"""mpcium_tpu — TPU-native threshold-signature (MPC/TSS) wallet framework.
+
+A brand-new JAX/XLA/Pallas-first implementation of the capabilities of the
+`mpcium` reference (Go, /root/reference): t-of-n distributed key generation,
+GG18 ECDSA (secp256k1) and EdDSA (Ed25519) threshold signing, and committee
+resharing, driven by an authenticated event plane with durable queues, peer
+registry, encrypted share storage, a client SDK and ops CLI.
+
+Unlike the reference — which runs one tss-lib session per wallet on CPU
+(reference: pkg/mpc/session.go) — the cryptographic core here is batched:
+multi-word modular arithmetic and curve ops are JAX kernels `vmap`ed over a
+leading *session* axis, so thousands of concurrent wallets' round computations
+run as one fixed-shape TPU dispatch (see SURVEY.md §2.2, §7).
+
+Layer map (mirrors SURVEY.md §7.2 build order):
+  core/       bignum limb arithmetic, prime fields, secp256k1 + ed25519,
+              Paillier, hashing (host-side control plane)
+  ops/        TPU-optimised kernels (Pallas / MXU paths) for the hot math
+  protocol/   transport-free round state machines: eddsa + ecdsa
+              keygen / signing / resharing
+  engine/     the batch scheduler: pad/bucket sessions into fixed-shape
+              dispatches, vmap/shard_map over the session axis
+  parallel/   mesh + sharding helpers (ICI-friendly layouts)
+  transport/  pub/sub, acked unicast, durable idempotent queues, dead-letter
+  registry/   peer liveness registry
+  store/      encrypted share store + wallet keyinfo metadata
+  identity/   Ed25519 node/initiator identities, envelope signing,
+              passphrase-encrypted keys at rest
+  node/       session factories (the reference's pkg/mpc/node.go analogue)
+  consumers/  event consumers (keygen / signing / resharing / timeout)
+  client/     MPCClient SDK
+  cli/        ops tooling (peers / identity / initiator bootstrap)
+  utils/      config, logging, serialization
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,92 @@
+"""Implementations of the ops CLI subcommands.
+
+Reference behaviors mirrored (see SURVEY.md §2.1 #2):
+- generate-peers  → peers.json {name: uuid}           (generate-peers.go:18-64)
+- register-peers  → registry dir / peers db           (register-peers.go:16-70)
+- generate-identity → <node>_identity.json + key file (generate-identity.go)
+- generate-initiator → initiator keypair + metadata   (generate-initiator.go)
+"""
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import platform
+import uuid
+from datetime import datetime, timezone
+
+
+def dispatch(args) -> int:
+    return {
+        "generate-peers": _generate_peers,
+        "register-peers": _register_peers,
+        "generate-identity": _generate_identity,
+        "generate-initiator": _generate_initiator,
+    }[args.command](args)
+
+
+def _generate_peers(args) -> int:
+    peers = {f"node{i}": str(uuid.uuid4()) for i in range(args.number)}
+    with open(args.output, "w") as f:
+        json.dump(peers, f, indent=2)
+    print(f"wrote {args.output} with {args.number} peers")
+    return 0
+
+
+def _register_peers(args) -> int:
+    from mpcium_tpu.registry.filekv import FileKV
+
+    with open(args.peers) as f:
+        peers = json.load(f)
+    kv = FileKV(args.registry_dir)
+    for name, node_id in peers.items():
+        kv.put(f"mpc_peers/{name}", node_id.encode())
+    print(f"registered {len(peers)} peers into {args.registry_dir}")
+    return 0
+
+
+def _require_password() -> str:
+    """Reference password policy: ≥12 chars incl. a special char
+    (generate-identity.go:53-63)."""
+    pw = getpass.getpass("passphrase: ")
+    if len(pw) < 12 or not any(not c.isalnum() for c in pw):
+        raise SystemExit(
+            "passphrase must be ≥12 chars and contain a special character"
+        )
+    if getpass.getpass("confirm passphrase: ") != pw:
+        raise SystemExit("passphrases do not match")
+    return pw
+
+
+def _generate_identity(args) -> int:
+    from mpcium_tpu.identity.store import generate_node_identity
+
+    with open(args.peers) as f:
+        peers = json.load(f)
+    if args.node not in peers:
+        raise SystemExit(f"node {args.node!r} not present in {args.peers}")
+    password = _require_password() if args.encrypt else None
+    paths = generate_node_identity(
+        args.identity_dir, args.node, peers[args.node], password=password
+    )
+    for p in paths:
+        print(f"wrote {p}")
+    return 0
+
+
+def _generate_initiator(args) -> int:
+    from mpcium_tpu.identity.store import generate_initiator_identity
+
+    password = _require_password() if args.encrypt else None
+    meta = {
+        "creator": os.environ.get("USER", "unknown"),
+        "host": platform.node(),
+        "os": f"{platform.system()} {platform.release()}",
+        "created_at": datetime.now(timezone.utc).isoformat(),
+    }
+    paths = generate_initiator_identity(
+        args.output_dir, password=password, metadata=meta
+    )
+    for p in paths:
+        print(f"wrote {p}")
+    return 0

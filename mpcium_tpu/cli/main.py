@@ -1,0 +1,53 @@
+"""`mpcium-tpu` — the node daemon entry point.
+
+Reference analogue: cmd/mpcium/main.go (`mpcium start -n node0`). The full
+daemon wiring lands with the node/consumers layers; this module always
+provides a working console entry.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from mpcium_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpcium-tpu",
+        description="TPU-native MPC/TSS wallet node daemon",
+    )
+    sub = p.add_subparsers(dest="command")
+    start = sub.add_parser("start", help="run an MPC node")
+    start.add_argument("-n", "--name", required=True, help="node name")
+    start.add_argument("--config", default="config.yaml", help="config file")
+    start.add_argument(
+        "--decrypt-private-key",
+        action="store_true",
+        help="prompt for passphrase to decrypt the node identity key",
+    )
+    start.add_argument("--debug", action="store_true")
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        print(__version__)
+        return 0
+    if args.command == "start":
+        from mpcium_tpu.node.daemon import run_node
+
+        return run_node(
+            name=args.name,
+            config_path=args.config,
+            decrypt_private_key=args.decrypt_private_key,
+            debug=args.debug,
+        )
+    build_parser().print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

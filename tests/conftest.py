@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; shardings are validated on a
+virtual 8-device CPU mesh (jax.sharding.Mesh semantics are identical). Real
+single-chip TPU benchmarking happens in bench.py, not in tests.
+"""
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

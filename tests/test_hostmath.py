@@ -1,0 +1,172 @@
+"""Property tests for the host-side reference math.
+
+Cross-verified against the `cryptography` package (OpenSSL-backed) so the
+reference implementation is independently pinned before it is used as ground
+truth for the TPU kernels.
+"""
+import hashlib
+import secrets
+
+import pytest
+
+from mpcium_tpu.core import hostmath as hm
+
+
+def test_secp_generator_on_curve():
+    g = hm.SECP_G
+    assert (g.y * g.y - g.x**3 - 7) % hm.SECP_P == 0
+
+
+def test_secp_group_law():
+    k1 = secrets.randbelow(hm.SECP_N)
+    k2 = secrets.randbelow(hm.SECP_N)
+    p1 = hm.secp_mul(k1, hm.SECP_G)
+    p2 = hm.secp_mul(k2, hm.SECP_G)
+    lhs = hm.secp_add(p1, p2)
+    rhs = hm.secp_mul((k1 + k2) % hm.SECP_N, hm.SECP_G)
+    assert lhs == rhs
+    # order annihilates
+    assert hm.secp_mul(hm.SECP_N, hm.SECP_G).is_infinity
+
+
+def test_secp_compress_roundtrip():
+    for _ in range(5):
+        pt = hm.secp_mul(secrets.randbelow(hm.SECP_N), hm.SECP_G)
+        assert hm.secp_decompress(hm.secp_compress(pt)) == pt
+        assert hm.secp_decode_xy(hm.secp_encode_xy(pt)) == pt
+
+
+def test_ecdsa_sign_verify_roundtrip():
+    priv = secrets.randbelow(hm.SECP_N - 1) + 1
+    pub = hm.secp_mul(priv, hm.SECP_G)
+    digest = int.from_bytes(hashlib.sha256(b"hello mpc").digest(), "big")
+    r, s, _rec = hm.ecdsa_sign_plain(priv, digest)
+    assert hm.ecdsa_verify(pub, digest, r, s)
+    assert not hm.ecdsa_verify(pub, digest + 1, r, s)
+
+
+def test_ecdsa_verify_against_openssl():
+    """Our signer must be accepted by an independent (OpenSSL) verifier."""
+    ec = pytest.importorskip("cryptography.hazmat.primitives.asymmetric.ec")
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
+
+    priv = secrets.randbelow(hm.SECP_N - 1) + 1
+    msg = b"tpu threshold signatures"
+    digest = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    r, s, _ = hm.ecdsa_sign_plain(priv, digest)
+
+    ossl_priv = ec.derive_private_key(priv, ec.SECP256K1())
+    ossl_pub = ossl_priv.public_key()
+    ossl_pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+
+    # and the reverse: OpenSSL-signed verifies under our verifier
+    sig = ossl_priv.sign(msg, ec.ECDSA(hashes.SHA256()))
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    r2, s2 = decode_dss_signature(sig)
+    assert hm.ecdsa_verify(
+        hm.secp_decode_xy(
+            ossl_pub.public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.UncompressedPoint,
+            )[1:]
+        ),
+        digest,
+        r2,
+        s2,
+    )
+
+
+def test_ed25519_rfc8032_vector():
+    # RFC 8032 §7.1 TEST 1 (empty message)
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    pub = bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig = bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert hm.ed25519_public_from_seed(seed) == pub
+    assert hm.ed25519_sign_plain(seed, b"") == sig
+    assert hm.ed25519_verify(pub, b"", sig)
+    assert not hm.ed25519_verify(pub, b"x", sig)
+
+
+def test_ed25519_rfc8032_vector2():
+    # RFC 8032 §7.1 TEST 2 (1-byte message)
+    seed = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+    )
+    pub = bytes.fromhex(
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+    )
+    msg = bytes.fromhex("72")
+    sig = hm.ed25519_sign_plain(seed, msg)
+    assert sig == bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+    )
+    assert hm.ed25519_verify(pub, msg, sig)
+
+
+def test_ed25519_against_openssl():
+    ced = pytest.importorskip(
+        "cryptography.hazmat.primitives.asymmetric.ed25519"
+    )
+    seed = secrets.token_bytes(32)
+    msg = b"cross-check"
+    sig = hm.ed25519_sign_plain(seed, msg)
+    ossl = ced.Ed25519PrivateKey.from_private_bytes(seed)
+    ossl.public_key().verify(sig, msg)  # raises on mismatch
+    # reverse direction
+    sig2 = ossl.sign(msg)
+    from cryptography.hazmat.primitives import serialization
+
+    pub_raw = ossl.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    assert hm.ed25519_verify(pub_raw, msg, sig2)
+
+
+def test_ed25519_group_law():
+    k1 = secrets.randbelow(hm.ED_L)
+    k2 = secrets.randbelow(hm.ED_L)
+    lhs = hm.ed_add(hm.ed_mul(k1, hm.ED_B), hm.ed_mul(k2, hm.ED_B))
+    rhs = hm.ed_mul((k1 + k2) % hm.ED_L, hm.ED_B)
+    assert lhs.equals(rhs)
+    assert hm.ed_mul(hm.ED_L, hm.ED_B).equals(hm.ED_IDENT)
+
+
+def test_ed_compress_roundtrip():
+    for _ in range(5):
+        pt = hm.ed_mul(secrets.randbelow(hm.ED_L), hm.ED_B)
+        assert hm.ed_decompress(hm.ed_compress(pt)).equals(pt)
+
+
+def test_shamir_roundtrip():
+    order = hm.SECP_N
+    secret = secrets.randbelow(order)
+    xs = [1, 2, 3, 4, 5]
+    _, shares = hm.shamir_share(secret, threshold=2, xs=xs, order=order)
+    # any 3 of 5 reconstruct
+    sub = {1: shares[1], 3: shares[3], 5: shares[5]}
+    assert hm.shamir_reconstruct(sub, order) == secret
+    # 2 of 5 do not
+    sub2 = {1: shares[1], 3: shares[3]}
+    assert hm.shamir_reconstruct(sub2, order) != secret
+
+
+def test_lagrange_identity():
+    order = hm.ED_L
+    xs = [2, 5, 9]
+    total = sum(hm.lagrange_coeff(xs, x, order) * x for x in xs) % order
+    # sum λ_i(0) * f(x_i) reconstructs f(0); for f(x)=x this is 0
+    assert total == 0
