@@ -1,0 +1,331 @@
+"""Batched multi-word (big-integer) arithmetic for JAX/TPU.
+
+This is the foundation of the TPU crypto core (SURVEY.md §7.2 step 1): the
+reference delegates all bignum work to Go libraries executed one session at a
+time (tss-lib Paillier/curve math, reference pkg/mpc/*_session.go); here every
+operation is expressed over fixed-shape int32 limb tensors with an arbitrary
+leading batch shape, so thousands of concurrent sessions' field operations run
+as one XLA dispatch.
+
+Representation
+--------------
+A big integer is a little-endian vector of ``n_limbs`` limbs, each holding
+``bits`` bits, stored in int32: shape (..., n_limbs). Radix ``B = 1<<bits``.
+
+Two bounds regimes:
+- *normalized*: every limb in [0, B) — produced by :func:`carry`.
+- *redundant*: limbs may temporarily exceed B (bounded by int32) between a
+  multiply and the following carry; all public helpers return normalized
+  values.
+
+The default profile (bits=12, n_limbs=22 → 264-bit capacity) is chosen so a
+schoolbook product column never overflows int32: 22 · (2^12-1)^2 < 2^31.
+Larger (Paillier-sized) integers pick a smaller radix via
+:func:`profile_for_bits`.
+
+Design notes (TPU): no data-dependent shapes and no Python branching on
+traced values; carry propagation is one `lax.scan`; multiplication is an
+einsum against a constant one-hot "convolution" tensor; exponentiation with a
+*constant* exponent is a `lax.scan` over the exponent's bits.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass(frozen=True)
+class LimbProfile:
+    """Static limb layout: ``n_limbs`` limbs of ``bits`` bits."""
+
+    bits: int
+    n_limbs: int
+
+    @property
+    def radix(self) -> int:
+        return 1 << self.bits
+
+    @property
+    def mask(self) -> int:
+        return self.radix - 1
+
+    @property
+    def capacity_bits(self) -> int:
+        return self.bits * self.n_limbs
+
+    def __post_init__(self):
+        # product column bound: n_limbs * (B-1)^2 + carry headroom < 2^31
+        assert self.n_limbs * (self.radix - 1) ** 2 < 2**31, (
+            "limb profile overflows int32 accumulation"
+        )
+
+
+# 264-bit capacity: covers all four ~256-bit moduli
+# (secp256k1 p and n, ed25519 p and l)
+P256 = LimbProfile(bits=12, n_limbs=22)
+
+
+def profile_for_bits(value_bits: int) -> LimbProfile:
+    """Pick an int32-safe limb profile for integers up to ``value_bits``."""
+    for bits in (12, 11, 10, 9, 8, 7):
+        n = -(-value_bits // bits)
+        if n * ((1 << bits) - 1) ** 2 < 2**31:
+            return LimbProfile(bits=bits, n_limbs=n)
+    raise ValueError(f"no int32-safe profile for {value_bits} bits")
+
+
+# ---------------------------------------------------------------------------
+# host <-> limb conversion
+# ---------------------------------------------------------------------------
+
+
+def to_limbs(x: int, prof: LimbProfile, n_limbs: int | None = None) -> np.ndarray:
+    n = n_limbs or prof.n_limbs
+    assert 0 <= x < 1 << (prof.bits * n), "value exceeds limb capacity"
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & prof.mask
+        x >>= prof.bits
+    return out
+
+
+def from_limbs(limbs, prof: LimbProfile) -> int:
+    arr = np.asarray(limbs)
+    assert arr.ndim == 1, "from_limbs is host-side, single value"
+    acc = 0
+    for i in range(arr.shape[0] - 1, -1, -1):
+        acc = (acc << prof.bits) + int(arr[i])
+    return acc
+
+
+def batch_to_limbs(xs, prof: LimbProfile, n_limbs: int | None = None) -> np.ndarray:
+    return np.stack([to_limbs(x, prof, n_limbs) for x in xs])
+
+
+def batch_from_limbs(arr, prof: LimbProfile) -> list:
+    a = np.asarray(arr)
+    flat = a.reshape(-1, a.shape[-1])
+    return [from_limbs(row, prof) for row in flat]
+
+
+# ---------------------------------------------------------------------------
+# carries / add / compare
+# ---------------------------------------------------------------------------
+
+
+def carry(x: jnp.ndarray, prof: LimbProfile) -> jnp.ndarray:
+    """Full carry propagation → normalized limbs, same shape.
+
+    Valid for any int32 limb values (including negative intermediates from
+    borrow-style subtraction) as long as the represented *total* is
+    non-negative and fits the limb count: the arithmetic right-shift
+    implements floor division, so negative limbs borrow correctly. Carry out
+    of the top limb is dropped (callers size tensors so it never occurs,
+    or deliberately exploit the mod-radix^n semantics).
+    """
+    bits = prof.bits
+
+    def step(c, limb):
+        t = limb + c
+        return t >> bits, t & prof.mask
+
+    _, out = lax.scan(
+        step, jnp.zeros(x.shape[:-1], jnp.int32), jnp.moveaxis(x, -1, 0)
+    )
+    return jnp.moveaxis(out, 0, -1)
+
+
+def compare(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic compare of normalized values: -1 / 0 / +1 (int32)."""
+    diff = jnp.sign(x - y)
+
+    def step(acc, d):
+        return jnp.where(acc == 0, d, acc), None
+
+    acc, _ = lax.scan(
+        step,
+        jnp.zeros(x.shape[:-1], jnp.int32),
+        jnp.moveaxis(diff, -1, 0),
+        reverse=True,
+    )
+    return acc
+
+
+def cond_sub(x: jnp.ndarray, m: jnp.ndarray, prof: LimbProfile) -> jnp.ndarray:
+    """If x ≥ m: x - m, else x. Normalized in/out, same width."""
+    ge = compare(x, m) >= 0
+    return jnp.where(ge[..., None], carry(x - m, prof), x)
+
+
+def pad_limbs(x: jnp.ndarray, extra: int) -> jnp.ndarray:
+    """Append ``extra`` zero limbs at the most-significant end."""
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+
+
+def shift_limbs(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by radix^k (prepend k zero limbs at the little end)."""
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(k, 0)])
+
+
+def take_limbs(x: jnp.ndarray, start: int, count: int) -> jnp.ndarray:
+    """Limbs [start, start+count), zero-padded past the top."""
+    n = x.shape[-1]
+    if start >= n:
+        return jnp.zeros(x.shape[:-1] + (count,), x.dtype)
+    sl = x[..., start : min(n, start + count)]
+    pad = count - sl.shape[-1]
+    if pad:
+        sl = pad_limbs(sl, pad)
+    return sl
+
+
+# ---------------------------------------------------------------------------
+# multiplication
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _conv_tensor(n_a: int, n_b: int) -> np.ndarray:
+    """One-hot (n_a, n_b, n_a+n_b-1) tensor M with M[i,j,i+j] = 1."""
+    m = np.zeros((n_a, n_b, n_a + n_b - 1), dtype=np.int32)
+    for i in range(n_a):
+        for j in range(n_b):
+            m[i, j, i + j] = 1
+    return m
+
+
+def mul(x: jnp.ndarray, y: jnp.ndarray, prof: LimbProfile) -> jnp.ndarray:
+    """Schoolbook product → normalized (..., n_x + n_y) limbs.
+
+    Inputs must be normalized (limb < radix) so column sums fit int32.
+    """
+    n_x, n_y = x.shape[-1], y.shape[-1]
+    m = jnp.asarray(_conv_tensor(n_x, n_y))
+    cols = jnp.einsum("...i,...j,ijn->...n", x, y, m)
+    return carry(pad_limbs(cols, 1), prof)
+
+
+def mul_small(x: jnp.ndarray, k: int, prof: LimbProfile) -> jnp.ndarray:
+    """x * k for a small python-int constant 0 ≤ k with k·radix < 2^31;
+    one extra output limb."""
+    assert 0 <= k * prof.radix < 2**31
+    return carry(pad_limbs(x * k, 1), prof)
+
+
+# ---------------------------------------------------------------------------
+# Barrett reduction (generic modulus)
+# ---------------------------------------------------------------------------
+
+
+class BarrettCtx:
+    """Precomputed Barrett context for a fixed modulus m with
+    radix^(n-1) ≤ m < radix^n (top limb in use).
+
+    reduce(x) maps normalized x < radix^(2n) (≤ 2n limbs) to x mod m using
+    the classic estimate  q̂ = floor(floor(x / r^(n-1)) · mu / r^(n+1)),
+    mu = floor(r^(2n) / m);  q̂ ∈ [q-2, q], fixed by two conditional
+    subtractions.
+
+    Used for the curve scalar rings (ed25519 l, secp256k1 n) and as the
+    generic engine behind Paillier arithmetic; the two field primes also have
+    faster pseudo-Mersenne folds in ``core.fields``.
+    """
+
+    def __init__(self, modulus: int, prof: LimbProfile = P256):
+        n = prof.n_limbs
+        assert prof.radix ** (n - 1) <= modulus < prof.radix**n, (
+            "modulus must occupy the top limb for Barrett"
+        )
+        self.prof = prof
+        self.modulus = modulus
+        self.m_limbs = to_limbs(modulus, prof)
+        self.m_limbs_p1 = to_limbs(modulus, prof, n_limbs=n + 1)
+        mu = (1 << (2 * n * prof.bits)) // modulus
+        self.mu_limbs = to_limbs(mu, prof, n_limbs=n + 2)
+
+    # -- core ---------------------------------------------------------------
+
+    def reduce(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x (normalized, any width ≤ 2n limbs) → x mod m (n limbs, canonical)."""
+        prof, n = self.prof, self.prof.n_limbs
+        batch = x.shape[:-1]
+        m1 = jnp.broadcast_to(jnp.asarray(self.m_limbs_p1), batch + (n + 1,))
+        mu = jnp.broadcast_to(jnp.asarray(self.mu_limbs), batch + (n + 2,))
+
+        q1 = take_limbs(x, n - 1, n + 1)  # floor(x / r^(n-1))
+        q2 = mul(q1, mu, prof)  # (n+1)+(n+2) limbs
+        q3 = take_limbs(q2, n + 1, n + 1)  # floor(q2 / r^(n+1))
+        q3m = mul(q3, m1, prof)
+
+        # r = (x mod r^(n+1)) - (q3·m mod r^(n+1)), then + r^(n+1) to keep the
+        # integer total positive; carry over n+2 limbs and drop limb n+1 (the
+        # mod). True r = x - q3·m ∈ [0, 3m) ⊂ [0, r^(n+1)), so the result is
+        # exact (HAC Alg. 14.42).
+        t = pad_limbs(take_limbs(x, 0, n + 1) - take_limbs(q3m, 0, n + 1), 1)
+        t = t.at[..., n + 1].add(1)
+        r = carry(t, prof)[..., : n + 1]
+        r = cond_sub(r, m1, prof)
+        r = cond_sub(r, m1, prof)
+        return r[..., :n]
+
+    # -- ring ops -----------------------------------------------------------
+
+    def mulmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self.reduce(mul(a, b, self.prof))
+
+    def addmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        n = self.prof.n_limbs
+        s = carry(pad_limbs(a + b, 1), self.prof)  # < 2m, n+1 limbs
+        m1 = jnp.broadcast_to(jnp.asarray(self.m_limbs_p1), s.shape)
+        return cond_sub(s, m1, self.prof)[..., :n]
+
+    def submod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        n = self.prof.n_limbs
+        m1 = jnp.broadcast_to(
+            jnp.asarray(self.m_limbs_p1), a.shape[:-1] + (n + 1,)
+        )
+        d = carry(m1 + pad_limbs(a, 1) - pad_limbs(b, 1), self.prof)  # a-b+m ∈ (0, 2m)
+        return cond_sub(d, m1, self.prof)[..., :n]
+
+    def negmod(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.submod(jnp.zeros_like(a), a)
+
+    def powmod_const(self, x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+        """x^e mod m for a python-int constant exponent ≥ 0 (left-to-right
+        square & multiply as one lax.scan over the exponent bits)."""
+        if exponent == 0:
+            return self.one_like(x)
+        ebits = jnp.asarray(
+            [(exponent >> i) & 1 for i in range(exponent.bit_length())][::-1],
+            dtype=jnp.int32,
+        )
+        one = self.one_like(x)
+
+        def step(acc, bit):
+            acc = self.mulmod(acc, acc)
+            acc = jnp.where(bit > 0, self.mulmod(acc, x), acc)
+            return acc, None
+
+        acc, _ = lax.scan(step, one, ebits)
+        return acc
+
+    def invmod_prime(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Batched modular inverse via Fermat — prime modulus only."""
+        return self.powmod_const(x, self.modulus - 2)
+
+    # -- helpers ------------------------------------------------------------
+
+    def one_like(self, x: jnp.ndarray) -> jnp.ndarray:
+        return (
+            jnp.zeros(x.shape[:-1] + (self.prof.n_limbs,), jnp.int32)
+            .at[..., 0]
+            .set(1)
+        )
+
+    def const(self, value: int, batch_shape=()) -> jnp.ndarray:
+        v = jnp.asarray(to_limbs(value % self.modulus, self.prof))
+        return jnp.broadcast_to(v, tuple(batch_shape) + (self.prof.n_limbs,))

@@ -6,13 +6,22 @@ single-chip TPU benchmarking happens in bench.py, not in tests.
 """
 import os
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax computations run. The ambient environment pins
+# JAX_PLATFORMS=axon (the single real TPU chip, reached over a tunnel — eager
+# op dispatch there is seconds per op); tests always run on the virtual
+# 8-device CPU platform — real-chip benchmarking lives in bench.py.
+# NOTE: the env var alone is overridden by the environment's baked-in
+# jax config ("axon,cpu"), so set the config knob directly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

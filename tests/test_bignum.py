@@ -1,0 +1,169 @@
+"""Property tests: JAX limb arithmetic vs python-int ground truth."""
+import secrets
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpcium_tpu.core import bignum as bn
+from mpcium_tpu.core import hostmath as hm
+
+PROF = bn.P256
+MODULI = {
+    "ed25519_p": hm.ED_P,
+    "ed25519_l": hm.ED_L,
+    "secp_p": hm.SECP_P,
+    "secp_n": hm.SECP_N,
+}
+
+
+def rand_ints(n, bound):
+    return [secrets.randbelow(bound) for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    xs = rand_ints(16, 1 << 264) + [0, 1, (1 << 264) - 1]
+    arr = bn.batch_to_limbs(xs, PROF)
+    assert bn.batch_from_limbs(arr, PROF) == xs
+
+
+def test_carry_normalizes_redundant():
+    # redundant limbs (values beyond radix / negative) normalize to the same
+    # integer: perturb limbs in pairs that preserve the represented total
+    x = 0xDEADBEEF_CAFEBABE_0123456789ABCDEF
+    limbs = bn.to_limbs(x, PROF).copy()
+    limbs[3] += PROF.radix  # +radix at weight 2^36 ...
+    limbs[4] -= 1  # ... -1 at weight 2^48: net zero
+    limbs[0] += 5 * PROF.radix
+    limbs[1] -= 5
+    out = bn.carry(jnp.asarray(limbs), PROF)
+    assert bn.from_limbs(np.asarray(out), PROF) == x
+
+
+def test_carry_handles_negative_borrow():
+    a, b = 2**200 + 12345, 2**199 + 999
+    la = jnp.asarray(bn.to_limbs(a, PROF))
+    lb = jnp.asarray(bn.to_limbs(b, PROF))
+    out = bn.carry(la - lb, PROF)
+    assert bn.from_limbs(np.asarray(out), PROF) == a - b
+
+
+def test_mul_batched():
+    xs = rand_ints(8, 1 << 256)
+    ys = rand_ints(8, 1 << 256)
+    lx = jnp.asarray(bn.batch_to_limbs(xs, PROF))
+    ly = jnp.asarray(bn.batch_to_limbs(ys, PROF))
+    prod = bn.mul(lx, ly, PROF)
+    got = bn.batch_from_limbs(prod, PROF)
+    assert got == [x * y for x, y in zip(xs, ys)]
+
+
+def test_compare():
+    pairs = [(5, 5), (1 << 200, (1 << 200) + 1), ((1 << 263) - 1, 77), (0, 0)]
+    lx = jnp.asarray(bn.batch_to_limbs([p[0] for p in pairs], PROF))
+    ly = jnp.asarray(bn.batch_to_limbs([p[1] for p in pairs], PROF))
+    out = np.asarray(bn.compare(lx, ly))
+    expected = [0, -1, 1, 0]
+    assert list(out) == expected
+
+
+@pytest.mark.parametrize("name", list(MODULI))
+def test_barrett_reduce(name):
+    m = MODULI[name]
+    ctx = bn.BarrettCtx(m)
+    xs = rand_ints(8, 1 << produce_bits()) + [0, m - 1, m, m + 1, 2 * m + 3]
+    arr = jnp.asarray(bn.batch_to_limbs(xs, PROF, n_limbs=2 * PROF.n_limbs))
+    out = bn.batch_from_limbs(ctx.reduce(arr), PROF)
+    assert out == [x % m for x in xs]
+
+
+def produce_bits():
+    return 2 * PROF.capacity_bits - 1  # just under radix^(2n)
+
+
+@pytest.mark.parametrize("name", list(MODULI))
+def test_barrett_ring_ops(name):
+    m = MODULI[name]
+    ctx = bn.BarrettCtx(m)
+    n = 8
+    xs, ys = rand_ints(n, m), rand_ints(n, m)
+    lx = jnp.asarray(bn.batch_to_limbs(xs, PROF))
+    ly = jnp.asarray(bn.batch_to_limbs(ys, PROF))
+    assert bn.batch_from_limbs(ctx.mulmod(lx, ly), PROF) == [
+        x * y % m for x, y in zip(xs, ys)
+    ]
+    assert bn.batch_from_limbs(ctx.addmod(lx, ly), PROF) == [
+        (x + y) % m for x, y in zip(xs, ys)
+    ]
+    assert bn.batch_from_limbs(ctx.submod(lx, ly), PROF) == [
+        (x - y) % m for x, y in zip(xs, ys)
+    ]
+
+
+def test_barrett_pow_and_inverse():
+    m = hm.ED_P  # prime
+    ctx = bn.BarrettCtx(m)
+    xs = rand_ints(4, m - 1)
+    xs = [x + 1 for x in xs]  # nonzero
+    lx = jnp.asarray(bn.batch_to_limbs(xs, PROF))
+    e = 65537
+    assert bn.batch_from_limbs(ctx.powmod_const(lx, e), PROF) == [
+        pow(x, e, m) for x in xs
+    ]
+    inv = ctx.invmod_prime(lx)
+    assert bn.batch_from_limbs(inv, PROF) == [pow(x, -1, m) for x in xs]
+
+
+def test_barrett_scalar_ring_matches_lagrange():
+    """End-use smoke: Lagrange coefficient arithmetic in the ed25519 scalar
+    ring computed in limbs matches hostmath."""
+    m = hm.ED_L
+    ctx = bn.BarrettCtx(m)
+    xs = [2, 5, 9]
+    lam_host = [hm.lagrange_coeff(xs, x, m) for x in xs]
+    # compute in limb arithmetic: num/den products then inverse
+    lams = []
+    for x_i in xs:
+        num, den = 1, 1
+        for x_j in xs:
+            if x_j == x_i:
+                continue
+            num = num * ((0 - x_j) % m) % m
+            den = den * ((x_i - x_j) % m) % m
+        ln = jnp.asarray(bn.to_limbs(num, PROF))
+        ld = jnp.asarray(bn.to_limbs(den, PROF))
+        out = ctx.mulmod(ln, ctx.invmod_prime(ld))
+        lams.append(bn.from_limbs(np.asarray(out), PROF))
+    assert lams == lam_host
+
+
+def test_mul_small_and_shift():
+    x = secrets.randbelow(1 << 250)
+    lx = jnp.asarray(bn.to_limbs(x, PROF))
+    out = bn.mul_small(lx, 9728, PROF)
+    assert bn.from_limbs(np.asarray(out), PROF) == x * 9728
+    sh = bn.shift_limbs(lx, 3)
+    assert bn.from_limbs(np.asarray(sh), PROF) == x << 36
+
+
+def test_paillier_sized_profile():
+    """The generic machinery works at Paillier modulus size (2048-bit)."""
+    prof = bn.profile_for_bits(2048 + 8)
+    p = secrets.randbelow(1 << 1024) | (1 << 1023) | 1
+    q = secrets.randbelow(1 << 1024) | (1 << 1023) | 1
+    m = p * q  # ~2048-bit odd modulus, top limb occupied
+    # ensure top limb occupied for Barrett precondition
+    assert prof.radix ** (prof.n_limbs - 1) <= m < prof.radix**prof.n_limbs
+    ctx = bn.BarrettCtx(m, prof)
+    xs = rand_ints(2, m)
+    ys = rand_ints(2, m)
+    lx = jnp.asarray(bn.batch_to_limbs(xs, prof))
+    ly = jnp.asarray(bn.batch_to_limbs(ys, prof))
+    assert bn.batch_from_limbs(ctx.mulmod(lx, ly), prof) == [
+        x * y % m for x, y in zip(xs, ys)
+    ]
+    e = 0x10001
+    assert bn.batch_from_limbs(ctx.powmod_const(lx, e), prof) == [
+        pow(x, e, m) for x in xs
+    ]
