@@ -1,0 +1,202 @@
+"""Batched edwards25519 group operations in JAX.
+
+Points are extended twisted-Edwards coordinates (X:Y:Z:T) with x·y = T·Z,
+each coordinate a 22-limb int32 tensor with arbitrary leading batch shape.
+The unified addition law is *complete* on the curve (a = -1, d non-square):
+no branches, identical code for add/double — exactly what XLA wants
+(SURVEY.md §7: compiler-friendly control flow, static shapes).
+
+Hot-path design: EdDSA keygen/signing is dominated by fixed-base scalar
+multiplications (nonce commitments R_i = r_i·B — reference round structure in
+pkg/mpc/eddsa_rounds.go). Fixed-base mults use a precomputed table of
+B·2^i constants (half the field-muls of double-and-add); variable-base mults
+(verification) use the double-and-add ladder with completeness-based selects.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import bignum as bn
+from . import hostmath as hm
+from .fields import ed25519_field
+
+PROF = bn.P256
+SCALAR_BITS = 256
+
+
+class EdPointJ(NamedTuple):
+    """Batch of extended-coordinate points; fields shaped (..., 22)."""
+
+    X: jnp.ndarray
+    Y: jnp.ndarray
+    Z: jnp.ndarray
+    T: jnp.ndarray
+
+    @property
+    def batch_shape(self):
+        return self.X.shape[:-1]
+
+
+def identity(batch_shape=()) -> EdPointJ:
+    F = ed25519_field()
+    zero = F.const(0, batch_shape)
+    one = F.const(1, batch_shape)
+    return EdPointJ(zero, one, one, zero)
+
+
+def from_host(points, batch_shape=None) -> EdPointJ:
+    """Build a batch from host points (hostmath.EdPoint or (x, y) ints)."""
+    F = ed25519_field()
+    xs, ys = [], []
+    for pt in points:
+        x, y = pt.affine() if isinstance(pt, hm.EdPoint) else pt
+        xs.append(x)
+        ys.append(y)
+    X = jnp.asarray(F.from_ints(xs))
+    Y = jnp.asarray(F.from_ints(ys))
+    T = F.mul(X, Y)
+    Z = F.const(1, X.shape[:-1])
+    return EdPointJ(X, Y, Z, T)
+
+
+def to_host(p: EdPointJ) -> list:
+    """Batch → list of hostmath.EdPoint (affine check included)."""
+    F = ed25519_field()
+    xs = F.to_ints(p.X)
+    ys = F.to_ints(p.Y)
+    zs = F.to_ints(p.Z)
+    ts = F.to_ints(p.T)
+    return [hm.EdPoint(x, y, z, t) for x, y, z, t in zip(xs, ys, zs, ts)]
+
+
+@functools.lru_cache(maxsize=None)
+def _d2_limbs() -> np.ndarray:
+    F = ed25519_field()
+    return bn.to_limbs(2 * hm.ED_D % hm.ED_P, PROF)
+
+
+def add(a: EdPointJ, b: EdPointJ) -> EdPointJ:
+    """Unified complete addition (RFC 8032 / HWCD08 'add-2008-hwcd-3')."""
+    F = ed25519_field()
+    A = F.mul(F.sub(a.Y, a.X), F.sub(b.Y, b.X))
+    B = F.mul(F.add(a.Y, a.X), F.add(b.Y, b.X))
+    C = F.mul(F.mul(a.T, b.T), jnp.broadcast_to(jnp.asarray(_d2_limbs()), a.T.shape))
+    D = F.mul_small(F.mul(a.Z, b.Z), 2)
+    E = F.sub(B, A)
+    Fv = F.sub(D, C)
+    G = F.add(D, C)
+    H = F.add(B, A)
+    return EdPointJ(F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
+
+
+def double(a: EdPointJ) -> EdPointJ:
+    return add(a, a)
+
+
+def select(mask: jnp.ndarray, a: EdPointJ, b: EdPointJ) -> EdPointJ:
+    """mask ? a : b, elementwise over the batch (mask: bool (...,))."""
+    m = mask[..., None]
+    return EdPointJ(
+        jnp.where(m, a.X, b.X),
+        jnp.where(m, a.Y, b.Y),
+        jnp.where(m, a.Z, b.Z),
+        jnp.where(m, a.T, b.T),
+    )
+
+
+def scalars_to_bits(ks, n_bits: int = SCALAR_BITS) -> np.ndarray:
+    """Host ints → (batch, n_bits) int32 little-endian bit array."""
+    out = np.zeros((len(ks), n_bits), dtype=np.int32)
+    for i, k in enumerate(ks):
+        assert 0 <= k < 1 << n_bits
+        for j in range(n_bits):
+            out[i, j] = (k >> j) & 1
+    return out
+
+
+def scalar_mul(bits: jnp.ndarray, p: EdPointJ) -> EdPointJ:
+    """Variable-base double-and-add; bits (..., 256) LSB-first."""
+    acc = identity(bits.shape[:-1])
+
+    def step(carry, bit):
+        acc, addend = carry
+        acc = select(bit > 0, add(acc, addend), acc)
+        return (acc, double(addend)), None
+
+    (acc, _), _ = lax.scan(step, (acc, p), jnp.moveaxis(bits, -1, 0))
+    return acc
+
+
+@functools.lru_cache(maxsize=None)
+def _base_table() -> tuple:
+    """Constants B·2^i for i in [0, 256): four (256, 22) int32 arrays."""
+    F = ed25519_field()
+    pts = []
+    cur = hm.ED_B
+    for _ in range(SCALAR_BITS):
+        pts.append(cur.affine())
+        cur = hm.ed_add(cur, cur)
+    X = F.from_ints([p[0] for p in pts])
+    Y = F.from_ints([p[1] for p in pts])
+    T = F.from_ints([p[0] * p[1] % hm.ED_P for p in pts])
+    Z = np.broadcast_to(bn.to_limbs(1, PROF), X.shape).copy()
+    return X, Y, Z, T
+
+
+def base_mul(bits: jnp.ndarray) -> EdPointJ:
+    """Fixed-base mult k·B via the B·2^i table: 256 conditional adds, no
+    doubling chain — the hot op for nonce commitments and keygen."""
+    Xt, Yt, Zt, Tt = (jnp.asarray(a) for a in _base_table())
+    acc = identity(bits.shape[:-1])
+
+    def step(acc, sl):
+        bit, X, Y, Z, T = sl
+        tbl = EdPointJ(*(jnp.broadcast_to(c, acc.X.shape) for c in (X, Y, Z, T)))
+        return select(bit > 0, add(acc, tbl), acc), None
+
+    acc, _ = lax.scan(
+        step, acc, (jnp.moveaxis(bits, -1, 0), Xt, Yt, Zt, Tt)
+    )
+    return acc
+
+
+def equal(a: EdPointJ, b: EdPointJ) -> jnp.ndarray:
+    """Batch equality, Z-invariant: X1·Z2 == X2·Z1 and Y1·Z2 == Y2·Z1."""
+    F = ed25519_field()
+    ex = F.eq(F.mul(a.X, b.Z), F.mul(b.X, a.Z))
+    ey = F.eq(F.mul(a.Y, b.Z), F.mul(b.Y, a.Z))
+    return ex & ey
+
+
+def compress(p: EdPointJ) -> jnp.ndarray:
+    """Batch compress → (..., 32) uint8, RFC 8032 encoding (little-endian y
+    with sign bit of x in the top bit)."""
+    F = ed25519_field()
+    zi = F.inv(p.Z)
+    x = F.canonical(F.mul(p.X, zi))
+    y = F.canonical(F.mul(p.Y, zi))
+    return _pack_bytes_le(y, sign=x[..., 0] & 1)
+
+
+def _pack_bytes_le(limbs: jnp.ndarray, sign=None) -> jnp.ndarray:
+    """Canonical 22×12-bit limbs → 32 bytes little-endian (values < 2^256)."""
+    bit_w = PROF.bits
+    # spread limbs to bits then regroup — static shapes, vector ops only
+    shifts = jnp.arange(bit_w, dtype=jnp.int32)
+    bits = (limbs[..., :, None] >> shifts) & 1  # (..., 22, 12)
+    bits = bits.reshape(limbs.shape[:-1] + (PROF.n_limbs * bit_w,))[..., :256]
+    if sign is not None:
+        bits = bits.at[..., 255].add(sign)  # top bit is 0 for canonical y < p
+    byte_shifts = jnp.arange(8, dtype=jnp.int32)
+    by = bits.reshape(bits.shape[:-1] + (32, 8))
+    return jnp.sum(by << byte_shifts, axis=-1).astype(jnp.uint8)
+
+
+def pack_scalar_bytes_le(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Canonical scalar limbs → (..., 32) uint8 little-endian."""
+    return _pack_bytes_le(limbs)
