@@ -1,0 +1,216 @@
+"""Batched secp256k1 group operations in JAX.
+
+Projective points (X:Y:Z) on y² = x³ + 7 with the *complete* addition
+formulas of Renes–Costello–Batina 2015 (Algorithm 7, short Weierstrass
+a = 0): one branch-free formula valid for every input pair, including
+doubling and the identity (0:1:0). Completeness costs ~40% more field muls
+than dedicated Jacobian add/double but removes all data-dependent control
+flow — the right trade for XLA/TPU batching (SURVEY.md §7).
+
+This is the curve under GG18 ECDSA (reference uses tss.S256() via
+btcec/dcrec — pkg/mpc/ecdsa_keygen_session.go:83); the hot ops are the nonce
+commitments Γ_i = γ_i·G and R reconstruction in the signing rounds.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import bignum as bn
+from . import hostmath as hm
+from .fields import secp256k1_field
+
+PROF = bn.P256
+SCALAR_BITS = 256
+_B3 = 21  # 3·b for b = 7
+
+
+class SecpPointJ(NamedTuple):
+    """Batch of projective points; fields shaped (..., 22)."""
+
+    X: jnp.ndarray
+    Y: jnp.ndarray
+    Z: jnp.ndarray
+
+    @property
+    def batch_shape(self):
+        return self.X.shape[:-1]
+
+
+def identity(batch_shape=()) -> SecpPointJ:
+    F = secp256k1_field()
+    return SecpPointJ(
+        F.const(0, batch_shape), F.const(1, batch_shape), F.const(0, batch_shape)
+    )
+
+
+def from_host(points) -> SecpPointJ:
+    """hostmath.SecpPoint list (no identities) → batch."""
+    F = secp256k1_field()
+    xs = [p.x for p in points]
+    ys = [p.y for p in points]
+    return SecpPointJ(
+        jnp.asarray(F.from_ints(xs)),
+        jnp.asarray(F.from_ints(ys)),
+        F.const(1, (len(points),)),
+    )
+
+
+def to_host(p: SecpPointJ) -> list:
+    """Batch → list of affine hostmath.SecpPoint (identity-aware)."""
+    F = secp256k1_field()
+    zs = F.to_ints(p.Z)
+    xs = F.to_ints(p.X)
+    ys = F.to_ints(p.Y)
+    out = []
+    for x, y, z in zip(xs, ys, zs):
+        if z == 0:
+            out.append(hm.SECP_INF)
+        else:
+            zi = pow(z, -1, hm.SECP_P)
+            out.append(hm.SecpPoint(x * zi % hm.SECP_P, y * zi % hm.SECP_P))
+    return out
+
+
+def add(a: SecpPointJ, b: SecpPointJ) -> SecpPointJ:
+    """Complete addition, RCB15 Algorithm 7 (a=0, b3=21)."""
+    F = secp256k1_field()
+    m, s, A = F.mul, F.mul_small, F.add
+    S = F.sub
+    t0 = m(a.X, b.X)
+    t1 = m(a.Y, b.Y)
+    t2 = m(a.Z, b.Z)
+    t3 = A(a.X, a.Y)
+    t4 = A(b.X, b.Y)
+    t3 = m(t3, t4)
+    t4 = A(t0, t1)
+    t3 = S(t3, t4)
+    t4 = A(a.Y, a.Z)
+    x3 = A(b.Y, b.Z)
+    t4 = m(t4, x3)
+    x3 = A(t1, t2)
+    t4 = S(t4, x3)
+    x3 = A(a.X, a.Z)
+    y3 = A(b.X, b.Z)
+    x3 = m(x3, y3)
+    y3 = A(t0, t2)
+    y3 = S(x3, y3)
+    x3 = A(t0, t0)
+    t0 = A(x3, t0)
+    t2 = s(t2, _B3)
+    z3 = A(t1, t2)
+    t1 = S(t1, t2)
+    y3 = s(y3, _B3)
+    x3 = m(t4, y3)
+    t2 = m(t3, t1)
+    x3 = S(t2, x3)
+    y3 = m(y3, t0)
+    t1 = m(t1, z3)
+    y3 = A(t1, y3)
+    t0 = m(t0, t3)
+    z3 = m(z3, t4)
+    z3 = A(z3, t0)
+    return SecpPointJ(x3, y3, z3)
+
+
+def double(a: SecpPointJ) -> SecpPointJ:
+    return add(a, a)
+
+
+def select(mask: jnp.ndarray, a: SecpPointJ, b: SecpPointJ) -> SecpPointJ:
+    m = mask[..., None]
+    return SecpPointJ(
+        jnp.where(m, a.X, b.X), jnp.where(m, a.Y, b.Y), jnp.where(m, a.Z, b.Z)
+    )
+
+
+def scalars_to_bits(ks, n_bits: int = SCALAR_BITS) -> np.ndarray:
+    out = np.zeros((len(ks), n_bits), dtype=np.int32)
+    for i, k in enumerate(ks):
+        assert 0 <= k < 1 << n_bits
+        for j in range(n_bits):
+            out[i, j] = (k >> j) & 1
+    return out
+
+
+def scalar_mul(bits: jnp.ndarray, p: SecpPointJ) -> SecpPointJ:
+    """Variable-base double-and-add; bits (..., 256) LSB-first."""
+    acc = identity(bits.shape[:-1])
+
+    def step(carry, bit):
+        acc, addend = carry
+        acc = select(bit > 0, add(acc, addend), acc)
+        return (acc, double(addend)), None
+
+    (acc, _), _ = lax.scan(step, (acc, p), jnp.moveaxis(bits, -1, 0))
+    return acc
+
+
+@functools.lru_cache(maxsize=None)
+def _base_table() -> tuple:
+    """Constants G·2^i for i in [0, 256): three (256, 22) int32 arrays."""
+    F = secp256k1_field()
+    pts = []
+    cur = hm.SECP_G
+    for _ in range(SCALAR_BITS):
+        pts.append((cur.x, cur.y))
+        cur = hm.secp_add(cur, cur)
+    X = F.from_ints([p[0] for p in pts])
+    Y = F.from_ints([p[1] for p in pts])
+    Z = np.broadcast_to(bn.to_limbs(1, PROF), X.shape).copy()
+    return X, Y, Z
+
+
+def base_mul(bits: jnp.ndarray) -> SecpPointJ:
+    """Fixed-base mult k·G via the G·2^i table."""
+    Xt, Yt, Zt = (jnp.asarray(a) for a in _base_table())
+    acc = identity(bits.shape[:-1])
+
+    def step(acc, sl):
+        bit, X, Y, Z = sl
+        tbl = SecpPointJ(*(jnp.broadcast_to(c, acc.X.shape) for c in (X, Y, Z)))
+        return select(bit > 0, add(acc, tbl), acc), None
+
+    acc, _ = lax.scan(step, acc, (jnp.moveaxis(bits, -1, 0), Xt, Yt, Zt))
+    return acc
+
+
+def equal(a: SecpPointJ, b: SecpPointJ) -> jnp.ndarray:
+    """Batch equality: cross-multiplied, Z-invariant, identity-aware."""
+    F = secp256k1_field()
+    ex = F.eq(F.mul(a.X, b.Z), F.mul(b.X, a.Z))
+    ey = F.eq(F.mul(a.Y, b.Z), F.mul(b.Y, a.Z))
+    za = F.is_zero(a.Z)
+    zb = F.is_zero(b.Z)
+    return jnp.where(za | zb, za == zb, ex & ey)
+
+
+def x_coordinate(p: SecpPointJ) -> jnp.ndarray:
+    """Affine x as canonical limbs (the ECDSA r source)."""
+    F = secp256k1_field()
+    return F.canonical(F.mul(p.X, F.inv(p.Z)))
+
+
+def compress(p: SecpPointJ) -> jnp.ndarray:
+    """Batch SEC1 compressed encoding → (..., 33) uint8 big-endian."""
+    F = secp256k1_field()
+    zi = F.inv(p.Z)
+    x = F.canonical(F.mul(p.X, zi))
+    y = F.canonical(F.mul(p.Y, zi))
+    xb = pack_be_32(x)
+    tag = (2 + (y[..., 0] & 1)).astype(jnp.uint8)
+    return jnp.concatenate([tag[..., None], xb], axis=-1)
+
+
+def pack_be_32(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Canonical limbs (< 2^256) → (..., 32) uint8 big-endian."""
+    shifts = jnp.arange(PROF.bits, dtype=jnp.int32)
+    bits = (limbs[..., :, None] >> shifts) & 1  # LSB-first
+    bits = bits.reshape(limbs.shape[:-1] + (PROF.n_limbs * PROF.bits,))[..., :256]
+    by = bits.reshape(bits.shape[:-1] + (32, 8))
+    vals = jnp.sum(by << jnp.arange(8, dtype=jnp.int32), axis=-1)
+    return jnp.flip(vals, axis=-1).astype(jnp.uint8)
