@@ -1,0 +1,165 @@
+"""Protocol plumbing shared by all six session types.
+
+The reference drives tss-lib `LocalParty` state machines and routes their
+wire messages over NATS (pkg/mpc/session.go:97-205). Here the protocol layer
+is *transport-free and deterministic*: a party object consumes/produces
+:class:`RoundMsg` values; routing, signing and persistence live in higher
+layers (node/, transport/). That inversion is what makes the protocol unit-
+testable in-process (SURVEY.md §4 "implication for the new framework") and
+batchable by the engine.
+
+Round messages carry JSON-safe payloads (ints as decimal strings, bytes as
+hex) so the wire envelope layer can serialize canonically for Ed25519
+signing — mirroring types.TssMessage.MarshalForSigning (reference
+pkg/types/tss.go:149-163).
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class ProtocolError(Exception):
+    """Protocol violation attributable to a peer (culprit recorded)."""
+
+    def __init__(self, message: str, culprit: Optional[str] = None):
+        super().__init__(message + (f" (culprit: {culprit})" if culprit else ""))
+        self.culprit = culprit
+
+
+@dataclass(frozen=True)
+class RoundMsg:
+    """One protocol message.
+
+    ``to`` is None for broadcast, else the recipient party ID — matching the
+    reference's broadcast/unicast split (session.go:116-133).
+    """
+
+    session_id: str
+    round: str
+    from_id: str
+    payload: Dict[str, Any]
+    to: Optional[str] = None
+
+    @property
+    def is_broadcast(self) -> bool:
+        return self.to is None
+
+
+def party_xs(party_ids: Sequence[str]) -> Dict[str, int]:
+    """Deterministic Shamir x-coordinates: 1-based rank in the sorted ID
+    list. Every party derives the same mapping from the same participant set
+    (the analogue of the reference's sorted PartyID universe,
+    node.go:288-301)."""
+    return {pid: i + 1 for i, pid in enumerate(sorted(party_ids))}
+
+
+class PartyBase:
+    """Common state for a protocol party.
+
+    Subclasses implement ``start() -> [RoundMsg]`` and
+    ``receive(RoundMsg) -> [RoundMsg]``; when ``done`` flips True the
+    ``result`` is available. Errors raise :class:`ProtocolError`.
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        self_id: str,
+        party_ids: Sequence[str],
+        rng=secrets,
+    ):
+        assert self_id in party_ids
+        self.session_id = session_id
+        self.self_id = self_id
+        self.party_ids = sorted(party_ids)
+        self.xs = party_xs(self.party_ids)
+        self.self_x = self.xs[self_id]
+        self.rng = rng
+        self.done = False
+        self.result: Any = None
+        # per-round inbox: round name -> {from_id: payload}
+        self._inbox: Dict[str, Dict[str, Dict[str, Any]]] = {}
+
+    # -- inbox machinery ----------------------------------------------------
+
+    def _store(self, msg: RoundMsg) -> None:
+        if msg.session_id != self.session_id:
+            raise ProtocolError(
+                f"message for session {msg.session_id!r} delivered to "
+                f"{self.session_id!r}"
+            )
+        if msg.from_id not in self.xs:
+            raise ProtocolError("message from non-participant", msg.from_id)
+        if msg.to is not None and msg.to != self.self_id:
+            # unicast not for us — transport error, drop loudly
+            raise ProtocolError(f"unicast for {msg.to!r} delivered to {self.self_id!r}")
+        box = self._inbox.setdefault(msg.round, {})
+        if msg.from_id in box:
+            # duplicate delivery is legal (at-least-once transport); ignore
+            # only if identical, else a peer equivocated
+            if box[msg.from_id] != msg.payload:
+                raise ProtocolError(
+                    f"equivocation in round {msg.round}", msg.from_id
+                )
+            return
+        box[msg.from_id] = msg.payload
+
+    def _round_full(self, round_name: str, expect_from: Sequence[str]) -> bool:
+        box = self._inbox.get(round_name, {})
+        return all(pid in box for pid in expect_from)
+
+    def _round_payloads(self, round_name: str) -> Dict[str, Dict[str, Any]]:
+        return self._inbox.get(round_name, {})
+
+    # -- helpers ------------------------------------------------------------
+
+    def others(self) -> List[str]:
+        return [p for p in self.party_ids if p != self.self_id]
+
+    def broadcast(self, round_name: str, payload: Dict[str, Any]) -> RoundMsg:
+        return RoundMsg(self.session_id, round_name, self.self_id, payload)
+
+    def unicast(self, to: str, round_name: str, payload: Dict[str, Any]) -> RoundMsg:
+        return RoundMsg(self.session_id, round_name, self.self_id, payload, to=to)
+
+
+@dataclass
+class KeygenShare:
+    """Durable per-wallet share record (the analogue of tss-lib
+    LocalPartySaveData persisted at ecdsa_keygen_session.go:102-113)."""
+
+    key_type: str  # "ed25519" | "secp256k1"
+    share: int  # Shamir share of the secret key, f(self_x)
+    self_x: int
+    public_key: bytes  # compressed group encoding
+    vss_commitments: List[bytes] = field(default_factory=list)  # aggregated
+    participants: List[str] = field(default_factory=list)
+    threshold: int = 0
+    aux: Dict[str, Any] = field(default_factory=dict)  # scheme-specific
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "key_type": self.key_type,
+            "share": str(self.share),
+            "self_x": self.self_x,
+            "public_key": self.public_key.hex(),
+            "vss_commitments": [c.hex() for c in self.vss_commitments],
+            "participants": self.participants,
+            "threshold": self.threshold,
+            "aux": self.aux,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "KeygenShare":
+        return cls(
+            key_type=d["key_type"],
+            share=int(d["share"]),
+            self_x=d["self_x"],
+            public_key=bytes.fromhex(d["public_key"]),
+            vss_commitments=[bytes.fromhex(c) for c in d["vss_commitments"]],
+            participants=list(d["participants"]),
+            threshold=d["threshold"],
+            aux=dict(d.get("aux", {})),
+        )

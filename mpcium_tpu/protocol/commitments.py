@@ -1,0 +1,36 @@
+"""Hash commitments (commit–reveal) used by every round-1 protocol step.
+
+The reference inherits tss-lib's HashCommitment scheme; functionally this is
+commit = H(blind ‖ data) with a fresh 256-bit blinding factor, revealed in
+the decommit round. Domain-separated SHA-256.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Sequence, Tuple
+
+_DOMAIN = b"mpcium-tpu/commit/v1"
+
+
+def commit(data: bytes, rng=secrets) -> Tuple[bytes, bytes]:
+    """→ (commitment, blinding)."""
+    blind = rng.token_bytes(32) if hasattr(rng, "token_bytes") else bytes(
+        rng.randbelow(256) for _ in range(32)
+    )
+    return hashlib.sha256(_DOMAIN + blind + data).digest(), blind
+
+
+def verify(commitment: bytes, blind: bytes, data: bytes) -> bool:
+    expect = hashlib.sha256(_DOMAIN + blind + data).digest()
+    return hmac.compare_digest(expect, commitment)
+
+
+def encode_points(points: Sequence[bytes]) -> bytes:
+    """Length-prefixed canonical concatenation of point encodings."""
+    out = [len(points).to_bytes(4, "big")]
+    for p in points:
+        out.append(len(p).to_bytes(2, "big"))
+        out.append(p)
+    return b"".join(out)

@@ -1,0 +1,169 @@
+"""Threshold Ed25519 signing.
+
+3-round commit–reveal threshold Schnorr matching the reference's EdDSA
+signing round count (pkg/mpc/eddsa_rounds.go:23-25):
+
+  R1 (broadcast)  hash commitment to the nonce share point R_i = r_i·B
+  R2 (broadcast)  decommitment: R_i
+  R3 (broadcast)  partial signature s_i = r_i + H(R‖A‖M)·λ_i·x_i mod l
+  finalize        s = Σ s_i; (R, s) must verify under RFC 8032
+
+The commitment round makes concurrent signing safe against ROS/Drijvers
+style nonce-bias attacks (each R_i is fixed before any is revealed). The
+final signature is a standard RFC 8032 Ed25519 signature over the wallet
+public key A — byte-compatible with the reference's output
+(eddsa_signing_session.go:147 verifies with edwards.Verify).
+
+Note: threshold signatures cannot use RFC 8032's *deterministic* nonce
+derivation (no party knows the full private key); nonces are random, as in
+the reference (tss-lib eddsa/signing).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ...core import hostmath as hm
+from .. import commitments as cm
+from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg
+
+R1 = "eddsa/sign/1"
+R2 = "eddsa/sign/2"
+R3 = "eddsa/sign/3"
+
+
+class EDDSASigningParty(PartyBase):
+    """One signer among the chosen quorum (|party_ids| ≥ t+1 participants,
+    all of whom hold keygen shares for this wallet)."""
+
+    def __init__(
+        self,
+        session_id: str,
+        self_id: str,
+        party_ids: Sequence[str],
+        share: KeygenShare,
+        message: bytes,
+        rng=None,
+    ):
+        import secrets as _secrets
+
+        super().__init__(session_id, self_id, party_ids, rng or _secrets)
+        if len(party_ids) < share.threshold + 1:
+            raise ProtocolError("not enough participants for threshold")
+        if share.key_type != "ed25519":
+            raise ValueError("wrong key type for EdDSA signing")
+        self.share = share
+        self.message = message
+        # Shamir x-coords come from the keygen participant universe, NOT the
+        # signing quorum — the reference reconstructs the same party universe
+        # from keyinfo (node.go:149-159)
+        from ..base import party_xs
+
+        keygen_xs = party_xs(share.participants)
+        for pid in party_ids:
+            if pid not in keygen_xs:
+                raise ProtocolError("signer not in keygen participant set", pid)
+        self.sign_xs = {pid: keygen_xs[pid] for pid in self.party_ids}
+        # PartyBase assigned quorum-local x-coords; Shamir evaluation points
+        # MUST come from the keygen universe or Lagrange interpolation is
+        # silently wrong for any quorum that isn't a sorted prefix.
+        self.xs = self.sign_xs
+        self.self_x = self.sign_xs[self_id]
+        assert self.self_x == share.self_x
+        self._sent_r2 = False
+        self._sent_r3 = False
+
+    # -- round 1 ------------------------------------------------------------
+
+    def start(self) -> List[RoundMsg]:
+        self._r = self.rng.randbelow(hm.ED_L - 1) + 1
+        self._R_i = hm.ed_mul(self._r, hm.ED_B)
+        self._R_i_bytes = hm.ed_compress(self._R_i)
+        self._commitment, self._blind = cm.commit(self._R_i_bytes, rng=self.rng)
+        return [self.broadcast(R1, {"commitment": self._commitment.hex()})]
+
+    # -- message handling ---------------------------------------------------
+
+    def receive(self, msg: RoundMsg) -> List[RoundMsg]:
+        if self.done:
+            return []
+        self._store(msg)
+        out: List[RoundMsg] = []
+        others = self.others()
+        if not self._sent_r2 and self._round_full(R1, others):
+            self._sent_r2 = True
+            out.append(
+                self.broadcast(
+                    R2,
+                    {"R": self._R_i_bytes.hex(), "blind": self._blind.hex()},
+                )
+            )
+        if (
+            self._sent_r2
+            and not self._sent_r3
+            and self._round_full(R2, others)
+        ):
+            out.append(self._round3())
+        if self._sent_r3 and not self.done and self._round_full(R3, others):
+            self._finalize()
+        return out
+
+    # -- round 3: partial signature -----------------------------------------
+
+    def _round3(self) -> RoundMsg:
+        self._sent_r3 = True
+        commits = self._round_payloads(R1)
+        decommits = self._round_payloads(R2)
+        R_points = {self.self_id: self._R_i}
+        for pid in self.others():
+            Rb = bytes.fromhex(decommits[pid]["R"])
+            if not cm.verify(
+                bytes.fromhex(commits[pid]["commitment"]),
+                bytes.fromhex(decommits[pid]["blind"]),
+                Rb,
+            ):
+                raise ProtocolError("nonce decommitment mismatch", pid)
+            try:
+                R_points[pid] = hm.ed_decompress(Rb)
+            except ValueError as e:
+                raise ProtocolError(f"bad nonce point: {e}", pid)
+
+        R = hm.ED_IDENT
+        for pid in self.party_ids:
+            R = hm.ed_add(R, R_points[pid])
+        self._R_bytes = hm.ed_compress(R)
+
+        c = hm.sha512_int_le(
+            self._R_bytes, self.share.public_key, self.message
+        ) % hm.ED_L
+        lam = hm.lagrange_coeff(
+            list(self.sign_xs.values()), self.self_x, hm.ED_L
+        )
+        s_i = (self._r + c * lam * self.share.share) % hm.ED_L
+        self._c = c
+        return self.broadcast(R3, {"s": str(s_i)})
+
+
+    # -- finalize -----------------------------------------------------------
+
+    def _finalize(self) -> None:
+        partials = self._round_payloads(R3)
+        s = 0
+        for pid in self.party_ids:
+            if pid == self.self_id:
+                continue
+            v = int(partials[pid]["s"])
+            if not 0 <= v < hm.ED_L:
+                raise ProtocolError("partial signature out of range", pid)
+            s = (s + v) % hm.ED_L
+        # add own partial
+        lam = hm.lagrange_coeff(
+            list(self.sign_xs.values()), self.self_x, hm.ED_L
+        )
+        s = (s + self._r + self._c * lam * self.share.share) % hm.ED_L
+        sig = self._R_bytes + s.to_bytes(32, "little")
+        # local verification before publishing, as the reference does
+        # (eddsa_signing_session.go:147)
+        if not hm.ed25519_verify(self.share.public_key, self.message, sig):
+            raise ProtocolError("aggregate signature failed verification")
+        self.result = sig
+        self.done = True

@@ -1,0 +1,38 @@
+"""In-process protocol driver: runs a set of parties to completion.
+
+The deterministic test fabric (SURVEY.md §4): messages route synchronously,
+broadcast fan-out + unicast, until every party reports done. Production
+routing happens over the transport layer instead; this runner pins protocol
+correctness independent of transport.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List
+
+from .base import PartyBase, RoundMsg
+
+
+def run_protocol(parties: Dict[str, PartyBase], max_msgs: int = 100_000) -> None:
+    """Drive all parties until done. Raises on protocol errors/stalls."""
+    queue: deque = deque()
+    for party in parties.values():
+        for m in party.start():
+            queue.append(m)
+    delivered = 0
+    while queue:
+        msg = queue.popleft()
+        delivered += 1
+        if delivered > max_msgs:
+            raise RuntimeError("protocol did not converge (message storm)")
+        targets: List[PartyBase] = (
+            [p for pid, p in parties.items() if pid != msg.from_id]
+            if msg.is_broadcast
+            else [parties[msg.to]]
+        )
+        for t in targets:
+            for out in t.receive(msg):
+                queue.append(out)
+    stalled = [pid for pid, p in parties.items() if not p.done]
+    if stalled:
+        raise RuntimeError(f"protocol stalled; undone parties: {stalled}")

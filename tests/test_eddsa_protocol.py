@@ -1,0 +1,146 @@
+"""End-to-end EdDSA threshold keygen + signing over the in-process runner.
+
+Independent verification via OpenSSL (cryptography) — the signature must be
+a standard RFC 8032 Ed25519 signature under the DKG public key.
+"""
+import secrets
+
+import pytest
+
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.protocol.base import ProtocolError
+from mpcium_tpu.protocol.eddsa.keygen import EDDSAKeygenParty
+from mpcium_tpu.protocol.eddsa.signing import EDDSASigningParty
+from mpcium_tpu.protocol.runner import run_protocol
+
+IDS = ["node-a", "node-b", "node-c"]
+
+
+def run_keygen(ids=IDS, threshold=1, session="w1"):
+    parties = {
+        pid: EDDSAKeygenParty(session, pid, ids, threshold) for pid in ids
+    }
+    run_protocol(parties)
+    return {pid: p.result for pid, p in parties.items()}
+
+
+def test_keygen_3party():
+    shares = run_keygen()
+    pubs = {s.public_key for s in shares.values()}
+    assert len(pubs) == 1
+    pub = pubs.pop()
+    # secret reconstructs consistently with the public key
+    pts = {s.self_x: s.share for s in shares.values()}
+    secret = hm.shamir_reconstruct(pts, hm.ED_L)
+    assert hm.ed_compress(hm.ed_mul(secret, hm.ED_B)) == pub
+    # t+1 = 2 shares reconstruct as well
+    two = dict(list(pts.items())[:2])
+    assert hm.shamir_reconstruct(two, hm.ED_L) == secret
+
+
+@pytest.mark.parametrize("quorum", [["node-a", "node-b"], IDS])
+def test_sign_with_quorum(quorum):
+    shares = run_keygen()
+    msg = b"solana-devnet tx: " + secrets.token_bytes(24)
+    signers = {
+        pid: EDDSASigningParty("w1-tx1", pid, quorum, shares[pid], msg)
+        for pid in quorum
+    }
+    run_protocol(signers)
+    sigs = {p.result for p in signers.values()}
+    assert len(sigs) == 1
+    sig = sigs.pop()
+    pub = shares[quorum[0]].public_key
+    assert hm.ed25519_verify(pub, msg, sig)
+    # independent OpenSSL verification
+    ed = pytest.importorskip("cryptography.hazmat.primitives.asymmetric.ed25519")
+    ed.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+
+
+def test_sign_wrong_message_fails_verify():
+    shares = run_keygen()
+    quorum = ["node-a", "node-c"]
+    msg = b"real tx"
+    signers = {
+        pid: EDDSASigningParty("w1-tx2", pid, quorum, shares[pid], msg)
+        for pid in quorum
+    }
+    run_protocol(signers)
+    sig = signers["node-a"].result
+    assert not hm.ed25519_verify(shares["node-a"].public_key, b"forged", sig)
+
+
+def test_sign_below_threshold_rejected():
+    shares = run_keygen(threshold=2)  # needs 3 signers
+    with pytest.raises(ProtocolError):
+        EDDSASigningParty(
+            "w1-tx3", "node-a", ["node-a", "node-b"], shares["node-a"], b"m"
+        )
+
+
+def test_keygen_detects_bad_share():
+    """A corrupted VSS share must be attributed to the sender."""
+    parties = {
+        pid: EDDSAKeygenParty("w2", pid, IDS, 1) for pid in IDS
+    }
+    from collections import deque
+
+    queue = deque()
+    for p in parties.values():
+        queue.extend(p.start())
+    try:
+        while queue:
+            msg = queue.popleft()
+            if (
+                msg.round == "eddsa/kg/2/share"
+                and msg.from_id == "node-b"
+                and msg.to == "node-a"
+            ):
+                bad = dict(msg.payload)
+                bad["share"] = str((int(bad["share"]) + 1) % hm.ED_L)
+                msg = type(msg)(msg.session_id, msg.round, msg.from_id, bad, msg.to)
+            targets = (
+                [p for pid, p in parties.items() if pid != msg.from_id]
+                if msg.is_broadcast
+                else [parties[msg.to]]
+            )
+            for t in targets:
+                queue.extend(t.receive(msg))
+        raise AssertionError("corruption went undetected")
+    except ProtocolError as e:
+        assert e.culprit == "node-b"
+
+
+def test_signing_detects_equivocating_decommit():
+    """R2 decommit not matching the R1 commitment is detected + attributed."""
+    shares = run_keygen()
+    quorum = IDS
+    signers = {
+        pid: EDDSASigningParty("w1-tx4", pid, quorum, shares[pid], b"m")
+        for pid in quorum
+    }
+    from collections import deque
+
+    queue = deque()
+    for p in signers.values():
+        queue.extend(p.start())
+    try:
+        while queue:
+            msg = queue.popleft()
+            if msg.round == "eddsa/sign/2" and msg.from_id == "node-c":
+                fake_R = hm.ed_compress(
+                    hm.ed_mul(secrets.randbelow(hm.ED_L), hm.ED_B)
+                )
+                bad = dict(msg.payload)
+                bad["R"] = fake_R.hex()
+                msg = type(msg)(msg.session_id, msg.round, msg.from_id, bad, msg.to)
+            targets = (
+                [p for pid, p in signers.items() if pid != msg.from_id]
+                if msg.is_broadcast
+                else [signers[msg.to]]
+            )
+            for t in targets:
+                queue.extend(t.receive(msg))
+        raise AssertionError("equivocation went undetected")
+    except ProtocolError as e:
+        assert e.culprit == "node-c"
