@@ -184,6 +184,59 @@ def take_limbs(x: jnp.ndarray, start: int, count: int) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# device-side byte/bit packing (wire format <-> limbs without host round-trip)
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_limbs_le(b: jnp.ndarray, prof: LimbProfile, n_limbs: int) -> jnp.ndarray:
+    """(..., n_bytes) uint8 little-endian → (..., n_limbs) normalized limbs.
+
+    Batched wire decode: round payloads arrive as fixed-shape byte tensors
+    (the TPU-native envelope) and are unpacked on device. Truncates or
+    zero-extends to the requested limb count.
+    """
+    n_bytes = b.shape[-1]
+    bit_idx = jnp.arange(8, dtype=jnp.int32)
+    bits = (b[..., :, None].astype(jnp.int32) >> bit_idx) & 1  # (..., nB, 8)
+    bits = bits.reshape(b.shape[:-1] + (n_bytes * 8,))
+    want = n_limbs * prof.bits
+    if bits.shape[-1] < want:
+        bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, want - bits.shape[-1])])
+    else:
+        bits = bits[..., :want]
+    groups = bits.reshape(bits.shape[:-1] + (n_limbs, prof.bits))
+    weights = (1 << jnp.arange(prof.bits, dtype=jnp.int32))
+    return jnp.sum(groups * weights, axis=-1).astype(jnp.int32)
+
+
+def limbs_to_bytes_le(x: jnp.ndarray, prof: LimbProfile, n_bytes: int) -> jnp.ndarray:
+    """Normalized limbs → (..., n_bytes) uint8 little-endian (wire encode)."""
+    bit_idx = jnp.arange(prof.bits, dtype=jnp.int32)
+    bits = (x[..., :, None] >> bit_idx) & 1  # (..., n, bits)
+    bits = bits.reshape(x.shape[:-1] + (x.shape[-1] * prof.bits,))
+    want = n_bytes * 8
+    if bits.shape[-1] < want:
+        bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, want - bits.shape[-1])])
+    else:
+        bits = bits[..., :want]
+    by = bits.reshape(bits.shape[:-1] + (n_bytes, 8))
+    return jnp.sum(by << jnp.arange(8, dtype=jnp.int32), axis=-1).astype(jnp.uint8)
+
+
+def limbs_to_bits(x: jnp.ndarray, prof: LimbProfile, n_bits: int) -> jnp.ndarray:
+    """Normalized limbs → (..., n_bits) int32 bit vector, LSB first (the
+    input format of the scalar-mult ladders)."""
+    bit_idx = jnp.arange(prof.bits, dtype=jnp.int32)
+    bits = (x[..., :, None] >> bit_idx) & 1
+    bits = bits.reshape(x.shape[:-1] + (x.shape[-1] * prof.bits,))
+    if bits.shape[-1] < n_bits:
+        return jnp.pad(
+            bits, [(0, 0)] * (bits.ndim - 1) + [(0, n_bits - bits.shape[-1])]
+        )
+    return bits[..., :n_bits]
+
+
+# ---------------------------------------------------------------------------
 # multiplication
 # ---------------------------------------------------------------------------
 

@@ -165,6 +165,48 @@ def base_mul(bits: jnp.ndarray) -> EdPointJ:
     return acc
 
 
+@functools.lru_cache(maxsize=None)
+def scalar_ring() -> bn.BarrettCtx:
+    """Barrett context for the group order l (the EdDSA scalar ring)."""
+    return bn.BarrettCtx(hm.ED_L, PROF)
+
+
+def decompress(b: jnp.ndarray):
+    """Batch RFC 8032 decode: (..., 32) uint8 → (EdPointJ, ok mask).
+
+    Invalid encodings (y ≥ p, non-residue x², x=0 with sign=1) yield the
+    identity with ok=False — callers mask, never branch. Square root per
+    p ≡ 5 (mod 8): x = u·v³·(u·v⁷)^((p-5)/8), fixed up by √-1.
+    """
+    F = ed25519_field()
+    sign = (b[..., 31] >> 7).astype(jnp.int32)
+    y_bytes = b.at[..., 31].set(b[..., 31] & 0x7F)
+    y = bn.bytes_to_limbs_le(y_bytes, PROF, PROF.n_limbs)
+    p_l = jnp.broadcast_to(jnp.asarray(bn.to_limbs(hm.ED_P, PROF)), y.shape)
+    ok = bn.compare(y, p_l) < 0
+    y2 = F.square(y)
+    one = F.one_like(y2)
+    u = F.sub(y2, one)
+    v = F.add(F.mul(F.const(hm.ED_D, y.shape[:-1]), y2), one)
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    pw = F.pow_const(F.mul(u, v7), (hm.ED_P - 5) // 8)
+    x = F.mul(F.mul(u, v3), pw)
+    vx2 = F.mul(v, F.square(x))
+    is_u = F.eq(vx2, u)
+    is_neg_u = F.eq(vx2, F.neg(u))
+    sqrt_m1 = F.const(pow(2, (hm.ED_P - 1) // 4, hm.ED_P), y.shape[:-1])
+    x = jnp.where(is_neg_u[..., None], F.mul(x, sqrt_m1), x)
+    ok = ok & (is_u | is_neg_u)
+    xc = F.canonical(x)
+    x_is_zero = jnp.all(xc == 0, axis=-1)
+    ok = ok & ~(x_is_zero & (sign == 1))
+    flip = (xc[..., 0] & 1) != sign
+    x = jnp.where(flip[..., None], F.neg(x), x)
+    pt = EdPointJ(x, y, F.one_like(y), F.mul(x, y))
+    return select(ok, pt, identity(ok.shape)), ok
+
+
 def equal(a: EdPointJ, b: EdPointJ) -> jnp.ndarray:
     """Batch equality, Z-invariant: X1·Z2 == X2·Z1 and Y1·Z2 == Y2·Z1."""
     F = ed25519_field()

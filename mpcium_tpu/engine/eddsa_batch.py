@@ -1,0 +1,298 @@
+"""Batched threshold-Ed25519 signing: the TPU execution engine.
+
+This is the framework's replacement for the reference's per-session
+goroutine concurrency (SURVEY.md §2.2 dimension 2 → the session batch
+axis): each MPC party coalesces the round compute of B concurrent signing
+sessions into single fixed-shape XLA dispatches. The protocol is the same
+commit–reveal threshold Schnorr as ``protocol.eddsa.signing`` (3 rounds,
+matching reference pkg/mpc/eddsa_rounds.go:23-25); here the per-round math
+runs on device over ``(B, …)`` tensors while hashing (commitments, the
+RFC 8032 challenge) stays host-side — hashing is control-plane (SURVEY.md
+§7.2 step 2).
+
+Wire format for batched rounds is *byte tensors*, not JSON: a party's
+round-1 message is the (B, 32) array of compressed nonce commitments, etc.
+Device-side pack/unpack (`bignum.bytes_to_limbs_le`) keeps the host out of
+the hot loop.
+
+Every public function is shape-stable: jit caches one executable per batch
+size. Use powers of two (pad the tail of a partial batch with dummy
+sessions; the `ok` masks make padding harmless).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import secrets
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import bignum as bn
+from ..core import ed25519_jax as ed
+from ..core import hostmath as hm
+from ..core.bignum import P256 as PROF
+
+# 512-bit inputs (hash outputs / wide nonces) occupy 43 twelve-bit limbs —
+# within BarrettCtx.reduce's 2n = 44-limb bound.
+_WIDE_LIMBS = 43
+
+
+def _reduce_wide(b64: jnp.ndarray) -> jnp.ndarray:
+    """(…, 64) uint8 little-endian → canonical scalar limbs mod l."""
+    L = ed.scalar_ring()
+    return L.reduce(bn.bytes_to_limbs_le(b64, PROF, _WIDE_LIMBS))
+
+
+# ---------------------------------------------------------------------------
+# jitted round kernels (party-local, batched over sessions)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def nonce_commitments(r64: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Round 1 compute. ``r64``: (..., 64) uint8 of fresh CSPRNG bytes.
+
+    Returns (r_limbs mod l, compressed R_i = r·B as (..., 32) uint8).
+    The 512→252-bit reduction makes the nonce statistically uniform mod l
+    (RFC 8032's own wide-reduction trick).
+    """
+    r = _reduce_wide(r64)
+    R = ed.base_mul(bn.limbs_to_bits(r, PROF, ed.SCALAR_BITS))
+    return r, ed.compress(R)
+
+
+@jax.jit
+def aggregate_nonce(R_all: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(q, B, 32) compressed nonce shares → ((B, 32) compressed R = Σ R_i,
+    (B,) validity mask). Decompression + point adds on device."""
+    pts, ok = ed.decompress(R_all)
+    acc = ed.EdPointJ(pts.X[0], pts.Y[0], pts.Z[0], pts.T[0])
+    for i in range(1, R_all.shape[0]):
+        acc = ed.add(acc, ed.EdPointJ(pts.X[i], pts.Y[i], pts.Z[i], pts.T[i]))
+    return ed.compress(acc), jnp.all(ok, axis=0)
+
+
+@jax.jit
+def partial_signature(
+    r_limbs: jnp.ndarray, c64: jnp.ndarray, lamx_limbs: jnp.ndarray
+) -> jnp.ndarray:
+    """Round 3 compute: s_i = r + H(R‖A‖M)·λ_i·x_i (mod l), batched.
+
+    ``c64``: raw SHA-512 digests (B, 64); ``lamx_limbs``: λ_i·x_i mod l as
+    limbs (λ from the keygen-universe x-coords; see protocol.eddsa.signing).
+    """
+    L = ed.scalar_ring()
+    c = _reduce_wide(c64)
+    return L.addmod(r_limbs, L.mulmod(c, lamx_limbs))
+
+
+@jax.jit
+def combine_signatures(
+    s_parts: jnp.ndarray, R_comp: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(q, B, 22) partial-sig limbs + (B, 32) R → ((B, 64) signatures,
+    (B, 22) s limbs). Signature layout per RFC 8032: R ‖ s little-endian."""
+    L = ed.scalar_ring()
+    s = s_parts[0]
+    for i in range(1, s_parts.shape[0]):
+        s = L.addmod(s, s_parts[i])
+    s_bytes = bn.limbs_to_bytes_le(s, PROF, 32)
+    return jnp.concatenate([R_comp, s_bytes], axis=-1), s
+
+
+@jax.jit
+def verify_signatures(
+    sig: jnp.ndarray, A_comp: jnp.ndarray, c64: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched RFC 8032 verification given precomputed challenge hashes:
+    s·B == R + c·A. Returns (B,) bool. (The challenge c64 = SHA512(R‖A‖M)
+    is hashed host-side; everything else runs on device.)"""
+    L = ed.scalar_ring()
+    R_pt, okR = ed.decompress(sig[..., :32])
+    A_pt, okA = ed.decompress(A_comp)
+    s = bn.bytes_to_limbs_le(sig[..., 32:], PROF, PROF.n_limbs)
+    l_l = jnp.broadcast_to(jnp.asarray(bn.to_limbs(hm.ED_L, PROF)), s.shape)
+    ok_range = bn.compare(s, l_l) < 0
+    c = _reduce_wide(c64)
+    lhs = ed.base_mul(bn.limbs_to_bits(s, PROF, ed.SCALAR_BITS))
+    rhs = ed.add(R_pt, ed.scalar_mul(bn.limbs_to_bits(c, PROF, ed.SCALAR_BITS), A_pt))
+    return ed.equal(lhs, rhs) & okR & okA & ok_range
+
+
+# ---------------------------------------------------------------------------
+# host helpers
+# ---------------------------------------------------------------------------
+
+
+def challenge_hashes(
+    R_comp: np.ndarray, A_comp: np.ndarray, messages: Sequence[bytes]
+) -> np.ndarray:
+    """Per-session SHA-512(R ‖ A ‖ M) → (B, 64) uint8."""
+    out = np.empty((len(messages), 64), dtype=np.uint8)
+    R = np.asarray(R_comp)
+    A = np.asarray(A_comp)
+    for i, m in enumerate(messages):
+        out[i] = np.frombuffer(
+            hashlib.sha512(R[i].tobytes() + A[i].tobytes() + m).digest(),
+            dtype=np.uint8,
+        )
+    return out
+
+
+def fresh_nonce_bytes(batch: int, rng=secrets) -> np.ndarray:
+    """(B, 64) CSPRNG bytes for round 1."""
+    return np.frombuffer(rng.token_bytes(batch * 64), dtype=np.uint8).reshape(
+        batch, 64
+    )
+
+
+def scalars_to_limb_batch(xs: Sequence[int]) -> np.ndarray:
+    """Host scalars (already reduced mod l) → (B, 22) int32."""
+    return bn.batch_to_limbs([x % hm.ED_L for x in xs], PROF)
+
+
+# ---------------------------------------------------------------------------
+# in-process co-signing fabric (bench / tests / loopback deployments)
+# ---------------------------------------------------------------------------
+
+
+class BatchedCoSigners:
+    """Drives q parties × B sessions of the 3-round signing protocol with
+    batched device compute per party per round — the measurement harness for
+    the throughput north star (SURVEY.md §6) and the reference
+    implementation for the distributed node's batched rounds.
+
+    ``party_shares``: for each of the q quorum parties, that party's
+    per-session key shares (length B, same wallet order). All sessions must
+    share one quorum topology (same party ids / x-coords); mixed topologies
+    belong in separate batches (the engine buckets by topology).
+    """
+
+    def __init__(
+        self,
+        party_ids: Sequence[str],
+        party_shares: Sequence[Sequence["KeygenShare"]],  # noqa: F821
+        rng=secrets,
+    ):
+        from ..protocol.base import party_xs
+
+        assert len(party_ids) == len(party_shares) >= 2
+        self.party_ids = list(party_ids)
+        self.q = len(party_ids)
+        self.B = len(party_shares[0])
+        assert all(len(s) == self.B for s in party_shares)
+        self.rng = rng
+
+        first = party_shares[0][0]
+        if self.q < first.threshold + 1:
+            raise ValueError("not enough participants for threshold")
+        universe_xs = party_xs(first.participants)
+        quorum_xs = [universe_xs[p] for p in party_ids]
+        # λ_i·x_i per (party, session): λ depends only on the quorum
+        # topology, shared across the batch
+        self.lamx = np.empty((self.q, self.B, PROF.n_limbs), dtype=np.int32)
+        for pi, (pid, shares) in enumerate(zip(party_ids, party_shares)):
+            lam = hm.lagrange_coeff(quorum_xs, universe_xs[pid], hm.ED_L)
+            self.lamx[pi] = scalars_to_limb_batch(
+                [lam * s.share % hm.ED_L for s in shares]
+            )
+            for s in shares:
+                if s.key_type != "ed25519":
+                    raise ValueError("wrong key type")
+                if s.participants != first.participants:
+                    raise ValueError(
+                        f"share for {pid!r} from a different participant "
+                        f"universe — bucket sessions by topology"
+                    )
+                if s.threshold != first.threshold:
+                    raise ValueError("mixed thresholds in one batch")
+                if s.self_x != universe_xs[pid]:
+                    raise ValueError(
+                        f"share self_x {s.self_x} does not belong to "
+                        f"{pid!r} (expected {universe_xs[pid]}) — "
+                        f"party_shares misaligned with party_ids"
+                    )
+        self.A_comp = np.stack(
+            [
+                np.frombuffer(s.public_key, dtype=np.uint8)
+                for s in party_shares[0]
+            ]
+        )
+
+    def sign(self, messages: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+        """Run the full 3-round protocol for B sessions → ((B, 64)
+        signatures, (B,) ok mask). Raises on commitment fraud."""
+        assert len(messages) == self.B
+        q, B = self.q, self.B
+
+        # -- round 1: nonce commitments (one (q, B) dispatch) + host commits -
+        r64 = np.stack([fresh_nonce_bytes(B, self.rng) for _ in range(q)])
+        r_limbs, R_comp = nonce_commitments(jnp.asarray(r64))  # (q,B,22)/(q,B,32)
+        R_host = np.asarray(R_comp)
+        from ..protocol import commitments as cm
+
+        commits: List[List[Tuple[bytes, bytes]]] = [
+            [cm.commit(R_host[p][i].tobytes(), self.rng) for i in range(B)]
+            for p in range(q)
+        ]
+
+        # -- round 2: decommit + verify (host hash check, device aggregate) -
+        for p in range(q):
+            for i in range(B):
+                c, blind = commits[p][i]
+                if not cm.verify(c, blind, R_host[p][i].tobytes()):
+                    raise RuntimeError("commitment fraud detected")
+        R_sum, ok_R = aggregate_nonce(jnp.asarray(R_host))
+
+        # -- round 3: challenge (host hash) + partials (one (q, B) dispatch)
+        c64 = jnp.asarray(
+            challenge_hashes(np.asarray(R_sum), self.A_comp, messages)
+        )
+        parts = partial_signature(
+            r_limbs,
+            jnp.broadcast_to(c64, (q,) + c64.shape),
+            jnp.asarray(self.lamx),
+        )
+        sigs, _ = combine_signatures(parts, R_sum)
+
+        # -- local verification before publishing (reference
+        # eddsa_signing_session.go:147) --------------------------------------
+        ok = verify_signatures(sigs, jnp.asarray(self.A_comp), c64)
+        return np.asarray(sigs), np.asarray(ok & ok_R)
+
+
+def dealer_keygen_batch(
+    n_wallets: int,
+    party_ids: Sequence[str],
+    threshold: int,
+    rng=secrets,
+):
+    """Trusted-dealer batch keygen for tests/bench setup ONLY — production
+    wallets come from the DKG protocol (protocol.eddsa.keygen). Returns
+    per-party lists of KeygenShare: result[i] belongs to party_ids[i],
+    wallet order aligned across parties."""
+    from ..protocol.base import KeygenShare, party_xs
+
+    xs = party_xs(party_ids)
+    out = [[] for _ in party_ids]
+    for _ in range(n_wallets):
+        secret = rng.randbelow(hm.ED_L - 1) + 1
+        _, shares = hm.shamir_share(
+            secret, threshold, [xs[p] for p in party_ids], hm.ED_L, rng=rng
+        )
+        pub = hm.ed_compress(hm.ed_mul(secret, hm.ED_B))
+        for i, pid in enumerate(party_ids):
+            out[i].append(
+                KeygenShare(
+                    key_type="ed25519",
+                    share=shares[xs[pid]],
+                    self_x=xs[pid],
+                    public_key=pub,
+                    participants=sorted(party_ids),
+                    threshold=threshold,
+                )
+            )
+    return out

@@ -138,9 +138,9 @@ class EDDSASigningParty(PartyBase):
         lam = hm.lagrange_coeff(
             list(self.sign_xs.values()), self.self_x, hm.ED_L
         )
-        s_i = (self._r + c * lam * self.share.share) % hm.ED_L
+        self._s_i = (self._r + c * lam * self.share.share) % hm.ED_L
         self._c = c
-        return self.broadcast(R3, {"s": str(s_i)})
+        return self.broadcast(R3, {"s": str(self._s_i)})
 
 
     # -- finalize -----------------------------------------------------------
@@ -155,11 +155,8 @@ class EDDSASigningParty(PartyBase):
             if not 0 <= v < hm.ED_L:
                 raise ProtocolError("partial signature out of range", pid)
             s = (s + v) % hm.ED_L
-        # add own partial
-        lam = hm.lagrange_coeff(
-            list(self.sign_xs.values()), self.self_x, hm.ED_L
-        )
-        s = (s + self._r + self._c * lam * self.share.share) % hm.ED_L
+        # add own partial (the exact value broadcast in round 3)
+        s = (s + self._s_i) % hm.ED_L
         sig = self._R_bytes + s.to_bytes(32, "little")
         # local verification before publishing, as the reference does
         # (eddsa_signing_session.go:147)
