@@ -1,0 +1,59 @@
+"""Throughput benchmark: batched threshold signatures per second on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Current flagship metric: ed25519 2-of-3 threshold signatures/sec through the
+full 3-round batched protocol (nonce commit+hash-commitment, decommit+
+aggregate, challenge+partials+combine+verify — host hashing included, i.e.
+end-to-end per-party work, not just the device kernels). The north-star
+baseline is 10k sigs/sec (BASELINE.md: secp256k1 2-of-3 on one TPU v5e; the
+reference's own path is sub-second *per* signature, serial). The metric will
+switch to secp256k1 GG18 once the ECDSA engine lands.
+"""
+from __future__ import annotations
+
+import json
+import secrets
+import time
+
+import numpy as np
+
+BASELINE_SIGS_PER_SEC = 10_000.0
+
+
+def main() -> None:
+    from mpcium_tpu.engine import eddsa_batch as eb
+
+    B = 4096
+    q, t = 2, 1
+    party_ids = ["node0", "node1", "node2"]
+    shares = eb.dealer_keygen_batch(B, party_ids, t, rng=secrets)
+    signer = eb.BatchedCoSigners(party_ids[:q], shares[:q], rng=secrets)
+    messages = [secrets.token_bytes(32) for _ in range(B)]
+
+    # warmup: compile all kernels at this batch size
+    sigs, ok = signer.sign(messages)
+    assert ok.all(), "warmup signatures invalid"
+
+    runs = 3
+    start = time.perf_counter()
+    for _ in range(runs):
+        sigs, ok = signer.sign(messages)
+        assert ok.all()
+    elapsed = time.perf_counter() - start
+
+    sigs_per_sec = runs * B / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_2of3_threshold_sigs_per_sec",
+                "value": round(sigs_per_sec, 1),
+                "unit": "signatures/sec",
+                "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,28 @@ def verify_signatures(
     return ed.equal(lhs, rhs) & okR & okA & ok_range
 
 
+@jax.jit
+def fused_sign_step(
+    r64: jnp.ndarray, c64: jnp.ndarray, lamx_limbs: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The whole device side of one batched signing step in ONE dispatch:
+    nonce scalars + commitments, nonce aggregation, partial signatures,
+    combine. ``r64`` (q, B, 64); ``c64`` (B, 64) challenge hashes;
+    ``lamx_limbs`` (q, B, 22). Returns ((B, 64) signatures, (B,) R-valid).
+
+    This is the single-chip flagship step (__graft_entry__.entry): in the
+    two-phase production flow the challenge is hashed between nonce
+    aggregation and partials, but the fused form is what one party executes
+    when replaying a round pipeline whose hashes are already known.
+    """
+    q = r64.shape[0]
+    r, R_comp = nonce_commitments(r64)
+    R_sum, ok_R = aggregate_nonce(R_comp)
+    parts = partial_signature(r, jnp.broadcast_to(c64, (q,) + c64.shape), lamx_limbs)
+    sigs, _ = combine_signatures(parts, R_sum)
+    return sigs, ok_R
+
+
 # ---------------------------------------------------------------------------
 # host helpers
 # ---------------------------------------------------------------------------

@@ -1,0 +1,128 @@
+"""Multi-device execution of the batched signing step.
+
+Two mesh axes map the framework's two parallelism dimensions (SURVEY.md
+§2.2): ``committee`` — the n MPC parties (the reference's n processes,
+dimension 1) — and ``sessions`` — the concurrent-wallet batch (dimension 2).
+Round tensors cross the committee axis as XLA collectives over ICI
+(`all_gather`), replacing the reference's NATS fan-out for the *intra-pod
+simulation / bench* topology. Production trust domains keep parties on
+separate hosts (SURVEY.md §7.4 item 6) — there the committee axis is 1 and
+cross-party bytes ride the host transport instead; the session axis still
+shards across each operator's own devices.
+
+The full signing step is two device phases with one host hash point between
+(the RFC 8032 challenge is SHA-512, control-plane):
+
+  phase A  nonce commit:  r64 → r, R_i;  all_gather(R) → R = Σ R_i
+  (host)   c = SHA512(R ‖ A ‖ M) per session
+  phase B  partials s_i = r + c·λ·x;  all_gather(s_i) → s = Σ s_i;
+           batched verify s·B == R + c·A
+"""
+from __future__ import annotations
+
+import functools
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import eddsa_batch as eb
+
+COMMITTEE = "committee"
+SESSIONS = "sessions"
+
+
+def make_mesh(n_devices: Optional[int] = None, committee: Optional[int] = None) -> Mesh:
+    """Mesh over (committee, sessions). Committee axis defaults to 2 when it
+    divides the device count (parties on distinct device rows), else 1
+    (committee unsharded; sessions take every device)."""
+    if n_devices is not None:
+        devs = jax.devices()[:n_devices]
+        assert len(devs) == n_devices, (
+            f"asked for {n_devices} devices, only {len(devs)} available — "
+            f"refusing to silently degrade the multi-device path"
+        )
+    else:
+        devs = jax.devices()
+    n = len(devs)
+    q_axis = committee if committee is not None else (2 if n % 2 == 0 and n >= 2 else 1)
+    assert n % q_axis == 0, f"committee axis {q_axis} must divide {n} devices"
+    arr = np.array(devs).reshape(q_axis, n // q_axis)
+    return Mesh(arr, (COMMITTEE, SESSIONS))
+
+
+@functools.lru_cache(maxsize=None)
+def commit_phase(mesh: Mesh):
+    """Jitted phase A over the mesh: (q, B, 64) nonce bytes →
+    ((q, B, 22) nonce scalars [sharded], (B, 32) compressed R [replicated
+    across committee], (B,) ok mask). Cached per mesh."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(COMMITTEE, SESSIONS),),
+        out_specs=(P(COMMITTEE, SESSIONS), P(SESSIONS), P(SESSIONS)),
+        check_vma=False,  # scan carries start as unvarying consts
+    )
+    def _phase(r64):
+        r, R_comp = eb.nonce_commitments(r64)
+        R_all = lax.all_gather(R_comp, COMMITTEE, tiled=True)  # (q, B_loc, 32)
+        R_sum, ok = eb.aggregate_nonce(R_all)
+        return r, R_sum, ok
+
+    return _phase
+
+
+@functools.lru_cache(maxsize=None)
+def sign_phase(mesh: Mesh):
+    """Jitted phase B over the mesh: nonce scalars + challenge hashes +
+    λ·x → ((B, 64) signatures, (B,) verified mask). Signature combine uses
+    an all_gather over the committee axis (modular sum is not a psum —
+    reduction happens in the scalar ring). Cached per mesh."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(COMMITTEE, SESSIONS),  # r limbs
+            P(SESSIONS),  # c64 (replicated over committee)
+            P(COMMITTEE, SESSIONS),  # λ·x limbs
+            P(SESSIONS),  # R_sum compressed
+            P(SESSIONS),  # A compressed
+        ),
+        out_specs=(P(SESSIONS), P(SESSIONS)),
+        check_vma=False,  # scan carries start as unvarying consts
+    )
+    def _phase(r, c64, lamx, R_sum, A_comp):
+        q_loc = r.shape[0]
+        parts = eb.partial_signature(
+            r, jnp.broadcast_to(c64, (q_loc,) + c64.shape), lamx
+        )
+        parts_all = lax.all_gather(parts, COMMITTEE, tiled=True)  # (q, B_loc, 22)
+        sigs, _ = eb.combine_signatures(parts_all, R_sum)
+        ok = eb.verify_signatures(sigs, A_comp, c64)
+        return sigs, ok
+
+    return _phase
+
+
+def sharded_sign(
+    mesh: Mesh,
+    r64: np.ndarray,
+    lamx: np.ndarray,
+    A_comp: np.ndarray,
+    messages,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full two-phase signing step over the mesh (host hash between)."""
+    r, R_sum, ok_R = commit_phase(mesh)(jnp.asarray(r64))
+    c64 = eb.challenge_hashes(np.asarray(R_sum), np.asarray(A_comp), messages)
+    sigs, ok = sign_phase(mesh)(
+        r, jnp.asarray(c64), jnp.asarray(lamx), R_sum, jnp.asarray(A_comp)
+    )
+    return np.asarray(sigs), np.asarray(ok) & np.asarray(ok_R)
