@@ -270,6 +270,72 @@ def mul_small(x: jnp.ndarray, k: int, prof: LimbProfile) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# wide multiplication (Paillier-sized operands)
+# ---------------------------------------------------------------------------
+#
+# The one-hot conv tensor of :func:`mul` is O(n²·2n) memory — fine for 22
+# limbs, hopeless for the 373-limb (4096-bit) Paillier domain. Wide values
+# multiply block-wise instead: split each operand into 32-limb blocks, form
+# all pairwise block products with the small conv tensor (an einsum XLA maps
+# to batched matmul), then overlap-add block products at their limb offsets.
+# Column bounds (11-bit limbs): 32·(2^11-1)² ≈ 1.3e8 per block product,
+# ≤ 12 block pairs per output block at 4096 bits → < 1.7e9 < 2^31. Larger
+# operand widths need a smaller radix via :func:`profile_for_bits`.
+
+_BLOCK = 32
+
+
+def _ceil_blocks(n: int) -> int:
+    return -(-n // _BLOCK)
+
+
+def mul_wide(x: jnp.ndarray, y: jnp.ndarray, prof: LimbProfile) -> jnp.ndarray:
+    """Schoolbook product for wide operands → normalized (..., n_x + n_y)
+    limbs. Inputs normalized; blocked into 32-limb chunks internally."""
+    n_x, n_y = x.shape[-1], y.shape[-1]
+    bx, by = _ceil_blocks(n_x), _ceil_blocks(n_y)
+    # int32 column bound: ≤ min(bx, by) block pairs per output block
+    assert min(bx, by) * _BLOCK * prof.mask**2 < 2**31, (
+        "limb radix too large for blocked accumulation at this width — "
+        "use profile_for_bits"
+    )
+    xb = take_limbs(x, 0, bx * _BLOCK).reshape(x.shape[:-1] + (bx, _BLOCK))
+    yb = take_limbs(y, 0, by * _BLOCK).reshape(y.shape[:-1] + (by, _BLOCK))
+    m = jnp.asarray(_conv_tensor(_BLOCK, _BLOCK))  # (32, 32, 63)
+    # all pairwise block products: (..., bx, by, 63)
+    prods = jnp.einsum("...ui,...vj,ijn->...uvn", xb, yb, m)
+    # overlap-add: block (u, v) lands at limb offset 32(u+v). Split each
+    # 63-limb product into low 32 + high 31 and scatter both halves onto the
+    # block grid via one-hot block-conv tensors.
+    bt = bx + by - 1
+    blk = jnp.asarray(_conv_tensor(bx, by))  # (bx, by, bt)
+    lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
+    hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
+    hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])  # 31 → 32 limbs
+    out_limbs = (bt + 1) * _BLOCK
+    lo_flat = jnp.pad(
+        lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (lo.ndim - 2) + [(0, _BLOCK)],
+    )
+    hi_flat = jnp.pad(
+        hi.reshape(hi.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (hi.ndim - 2) + [(_BLOCK, 0)],
+    )
+    # normalize halves separately first: their raw column sums can each
+    # approach 2^31, so adding before a carry would overflow int32
+    total = carry(carry(lo_flat, prof) + carry(hi_flat, prof), prof)
+    assert out_limbs >= n_x + n_y
+    return total[..., : n_x + n_y]
+
+
+def mul_auto(x: jnp.ndarray, y: jnp.ndarray, prof: LimbProfile) -> jnp.ndarray:
+    """Dispatch to the dense conv product (narrow) or blocked product (wide)."""
+    if max(x.shape[-1], y.shape[-1]) > 2 * _BLOCK:
+        return mul_wide(x, y, prof)
+    return mul(x, y, prof)
+
+
+# ---------------------------------------------------------------------------
 # Barrett reduction (generic modulus)
 # ---------------------------------------------------------------------------
 
@@ -310,9 +376,9 @@ class BarrettCtx:
         mu = jnp.broadcast_to(jnp.asarray(self.mu_limbs), batch + (n + 2,))
 
         q1 = take_limbs(x, n - 1, n + 1)  # floor(x / r^(n-1))
-        q2 = mul(q1, mu, prof)  # (n+1)+(n+2) limbs
+        q2 = mul_auto(q1, mu, prof)  # (n+1)+(n+2) limbs
         q3 = take_limbs(q2, n + 1, n + 1)  # floor(q2 / r^(n+1))
-        q3m = mul(q3, m1, prof)
+        q3m = mul_auto(q3, m1, prof)
 
         # r = (x mod r^(n+1)) - (q3·m mod r^(n+1)), then + r^(n+1) to keep the
         # integer total positive; carry over n+2 limbs and drop limb n+1 (the
@@ -328,7 +394,7 @@ class BarrettCtx:
     # -- ring ops -----------------------------------------------------------
 
     def mulmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        return self.reduce(mul(a, b, self.prof))
+        return self.reduce(mul_auto(a, b, self.prof))
 
     def addmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         n = self.prof.n_limbs
@@ -369,6 +435,43 @@ class BarrettCtx:
     def invmod_prime(self, x: jnp.ndarray) -> jnp.ndarray:
         """Batched modular inverse via Fermat — prime modulus only."""
         return self.powmod_const(x, self.modulus - 2)
+
+    def powmod(self, x: jnp.ndarray, ebits: jnp.ndarray) -> jnp.ndarray:
+        """x^e mod m with *per-element* exponents: ``ebits`` (..., n_bits)
+        int32 LSB-first (see :func:`limbs_to_bits`). Right-to-left binary:
+        two mulmods per bit, batched. The workhorse of Paillier homomorphic
+        scalar-mul and ZK-proof responses, where exponents vary by session."""
+        one = self.one_like(x)
+
+        def step(acc_base, bit):
+            acc, base = acc_base
+            acc = jnp.where((bit > 0)[..., None], self.mulmod(acc, base), acc)
+            return (acc, self.mulmod(base, base)), None
+
+        (acc, _), _ = lax.scan(step, (one, x), jnp.moveaxis(ebits, -1, 0))
+        return acc
+
+    def powmod_fixed_base(self, base: int, ebits: jnp.ndarray) -> jnp.ndarray:
+        """base^e mod m for a python-int base with per-element exponents.
+        Precomputes the base^(2^i) table host-side → one mulmod per bit
+        (half the device work of :meth:`powmod`)."""
+        n_bits = ebits.shape[-1]
+        tbl = np.empty((n_bits, self.prof.n_limbs), dtype=np.int32)
+        b = base % self.modulus
+        for i in range(n_bits):
+            tbl[i] = to_limbs(b, self.prof)
+            b = b * b % self.modulus
+        one = self.one_like(ebits)  # one_like only uses the batch shape
+
+        def step(acc, sl):
+            bit, t = sl
+            t = jnp.broadcast_to(t, acc.shape)
+            return jnp.where((bit > 0)[..., None], self.mulmod(acc, t), acc), None
+
+        acc, _ = lax.scan(
+            step, one, (jnp.moveaxis(ebits, -1, 0), jnp.asarray(tbl))
+        )
+        return acc
 
     # -- helpers ------------------------------------------------------------
 

@@ -1,0 +1,402 @@
+"""Paillier cryptosystem + GG18 pre-parameters.
+
+The reference's entire Paillier stack lives in tss-lib (ECDSA keygen round 1
+broadcasts each party's Paillier pubkey; the MtA share-conversion in signing
+is Paillier-homomorphic arithmetic — SURVEY.md §2.3). Pre-parameters
+(`keygen.GeneratePreParams`, reference pkg/mpc/node.go:69) are the expensive
+startup artifact: a Paillier keypair plus the ring-Pedersen modulus
+NTilde = P·Q (safe primes) with bases h1, h2 used by the MtA range proofs.
+
+Split of labor (SURVEY.md §7.2 step 3):
+- key/prime generation: host-side python-int (safe-prime search is
+  branch-heavy trial division — hostile to XLA; the reference also runs it
+  on CPU at startup with a 5-minute budget). A pool file amortizes it.
+- encrypt/decrypt/homomorphic ops: host reference implementation here, and
+  *batched device kernels* in :class:`PaillierBatch` — fixed-shape modexps
+  over the session axis, the dominant GG18 signing cost.
+
+Limb layout: one radix (11-bit limbs) across the 2048-bit (mod N, mod
+NTilde) and 4096-bit (mod N²) domains so values move between them by
+zero-padding, no repacking.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import secrets
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import bignum as bn
+
+# one radix family for all Paillier-domain arithmetic
+PROF_2048 = bn.LimbProfile(bits=11, n_limbs=187)  # capacity 2057 bits
+PROF_4096 = bn.LimbProfile(bits=11, n_limbs=373)  # capacity 4103 bits
+
+PAILLIER_BITS = 2048
+
+
+# ---------------------------------------------------------------------------
+# host primality / prime generation
+# ---------------------------------------------------------------------------
+
+_SMALL_PRIMES = [p for p in range(3, 1000) if all(p % d for d in range(2, p))]
+
+
+def is_probable_prime(n: int, rounds: int = 30, rng=secrets) -> bool:
+    """Miller–Rabin with random bases (error ≤ 4^-rounds)."""
+    if n < 2:
+        return False
+    for p in _SMALL_PRIMES:
+        if n == p:
+            return True
+        if n % p == 0:
+            return False
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = rng.randbelow(n - 3) + 2
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def gen_prime(bits: int, rng=secrets) -> int:
+    while True:
+        c = rng.randbits(bits) | (1 << (bits - 1)) | 1
+        if is_probable_prime(c, rng=rng):
+            return c
+
+
+def gen_safe_prime(bits: int, rng=secrets) -> int:
+    """p = 2q+1 with q prime. Sieve on both p and q before Miller–Rabin."""
+    while True:
+        q = rng.randbits(bits - 1) | (1 << (bits - 2)) | 1
+        p = 2 * q + 1
+        if any(q % s == 0 or p % s == 0 for s in _SMALL_PRIMES):
+            continue
+        # cheap base-2 Fermat screens before full MR
+        if pow(2, q - 1, q) != 1:
+            continue
+        if pow(2, p - 1, p) != 1:
+            continue
+        if is_probable_prime(q, rng=rng) and is_probable_prime(p, rng=rng):
+            return p
+
+
+# ---------------------------------------------------------------------------
+# Paillier keys (host)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PaillierPublicKey:
+    N: int
+
+    @property
+    def N2(self) -> int:
+        return self.N * self.N
+
+    @property
+    def g(self) -> int:  # standard g = N + 1
+        return self.N + 1
+
+    def encrypt(self, m: int, r: Optional[int] = None, rng=secrets) -> int:
+        assert 0 <= m < self.N
+        if r is None:
+            while True:
+                r = rng.randbelow(self.N)
+                if r and math.gcd(r, self.N) == 1:
+                    break
+        # (1+N)^m = 1 + mN (mod N²)
+        return (1 + m * self.N) % self.N2 * pow(r, self.N, self.N2) % self.N2
+
+    def add(self, c1: int, c2: int) -> int:
+        return c1 * c2 % self.N2
+
+    def scalar_mul(self, c: int, k: int) -> int:
+        return pow(c, k, self.N2)
+
+    def to_json(self) -> dict:
+        return {"N": str(self.N)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PaillierPublicKey":
+        return cls(N=int(d["N"]))
+
+
+@dataclass(frozen=True)
+class PaillierPrivateKey:
+    p: int
+    q: int
+
+    @property
+    def public(self) -> PaillierPublicKey:
+        return PaillierPublicKey(self.p * self.q)
+
+    @property
+    def N(self) -> int:
+        return self.p * self.q
+
+    @functools.cached_property
+    def lam(self) -> int:  # λ = lcm(p-1, q-1)
+        return (self.p - 1) * (self.q - 1) // math.gcd(self.p - 1, self.q - 1)
+
+    @functools.cached_property
+    def mu(self) -> int:  # μ = (L(g^λ mod N²))⁻¹ mod N
+        N = self.N
+        u = pow(N + 1, self.lam, N * N)
+        return pow((u - 1) // N, -1, N)
+
+    def decrypt(self, c: int) -> int:
+        N = self.N
+        u = pow(c, self.lam, N * N)
+        return (u - 1) // N * self.mu % N
+
+    def to_json(self) -> dict:
+        return {"p": str(self.p), "q": str(self.q)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PaillierPrivateKey":
+        return cls(p=int(d["p"]), q=int(d["q"]))
+
+
+def gen_paillier_key(bits: int = PAILLIER_BITS, rng=secrets) -> PaillierPrivateKey:
+    """Distinct primes p≠q with N exactly ``bits`` bits."""
+    half = bits // 2
+    while True:
+        p = gen_prime(half, rng)
+        q = gen_prime(half, rng)
+        if p != q and (p * q).bit_length() == bits:
+            return PaillierPrivateKey(p=min(p, q), q=max(p, q))
+
+
+# ---------------------------------------------------------------------------
+# safe-prime pool (amortizes the startup search; reference budget is 5 min,
+# node.go:69 — a pool file makes restarts instant)
+# ---------------------------------------------------------------------------
+
+
+def pool_take(path, count: int = 2, bits: int = 1024, rng=secrets) -> list:
+    """Pop ``count`` safe primes from a JSON pool file ({"bits", "safe_primes":
+    [str]}), generating fresh ones when the pool is short. The file is
+    rewritten without the consumed primes (a prime must never be reused
+    across NTilde moduli). Missing file ⇒ all primes generated fresh."""
+    import json
+    import os
+
+    primes: list = []
+    data = None
+    if path is not None and os.path.exists(path):
+        data = json.load(open(path))
+        assert data.get("bits", bits) == bits, "pool bit-size mismatch"
+        avail = [int(p) for p in data.get("safe_primes", [])]
+        take, rest = avail[:count], avail[count:]
+        primes.extend(take)
+        if take:
+            data["safe_primes"] = [str(p) for p in rest]
+            tmp = str(path) + ".tmp"
+            json.dump(data, open(tmp, "w"))
+            os.replace(tmp, path)
+    while len(primes) < count:
+        primes.append(gen_safe_prime(bits, rng))
+    return primes
+
+
+def pool_fill(path, target: int, bits: int = 1024, rng=secrets) -> int:
+    """Top the pool file up to ``target`` primes; returns how many were
+    generated. Run from a background thread / cron on production nodes."""
+    import json
+    import os
+
+    data = {"bits": bits, "safe_primes": []}
+    if os.path.exists(path):
+        data = json.load(open(path))
+        assert data.get("bits", bits) == bits
+    made = 0
+    while len(data["safe_primes"]) < target:
+        data["safe_primes"].append(str(gen_safe_prime(bits, rng)))
+        made += 1
+        tmp = str(path) + ".tmp"
+        json.dump(data, open(tmp, "w"))
+        os.replace(tmp, path)
+    return made
+
+
+# ---------------------------------------------------------------------------
+# GG18 pre-parameters (ring-Pedersen / NTilde)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PreParams:
+    """Per-node startup artifact (reference node.go:69 GeneratePreParams):
+    Paillier key + ring-Pedersen parameters for MtA range proofs.
+    ``alpha``/``beta`` are the secret dlogs (h2 = h1^alpha, h1 = h2^beta
+    mod NTilde) needed to produce the DLN proofs exchanged in keygen."""
+
+    paillier: PaillierPrivateKey
+    NTilde: int
+    h1: int
+    h2: int
+    alpha: int
+    beta: int
+    # safe-prime factors of NTilde (kept for possible proof extensions)
+    P: int
+    Q: int
+
+    def to_json(self) -> dict:
+        return {
+            "paillier": self.paillier.to_json(),
+            "NTilde": str(self.NTilde),
+            "h1": str(self.h1),
+            "h2": str(self.h2),
+            "alpha": str(self.alpha),
+            "beta": str(self.beta),
+            "P": str(self.P),
+            "Q": str(self.Q),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PreParams":
+        return cls(
+            paillier=PaillierPrivateKey.from_json(d["paillier"]),
+            NTilde=int(d["NTilde"]),
+            h1=int(d["h1"]),
+            h2=int(d["h2"]),
+            alpha=int(d["alpha"]),
+            beta=int(d["beta"]),
+            P=int(d["P"]),
+            Q=int(d["Q"]),
+        )
+
+
+def gen_preparams(
+    bits: int = PAILLIER_BITS,
+    rng=secrets,
+    safe_primes: Optional[Tuple[int, int]] = None,
+    pool_path=None,
+) -> PreParams:
+    """Generate node pre-parameters. ``safe_primes`` short-circuits the
+    expensive search; ``pool_path`` draws from a :func:`pool_take` file.
+    Matches tss-lib's construction: NTilde from safe primes, h1 a random
+    square, h2 = h1^alpha."""
+    half = bits // 2
+    if safe_primes is not None:
+        P, Q = safe_primes
+    elif pool_path is not None:
+        P, Q = pool_take(pool_path, count=2, bits=half, rng=rng)
+    else:
+        P = gen_safe_prime(half, rng)
+        while True:
+            Q = gen_safe_prime(half, rng)
+            if Q != P:
+                break
+    NTilde = P * Q
+    pq = (P - 1) // 2 * ((Q - 1) // 2)  # order of the squares subgroup
+    f = rng.randbelow(NTilde - 2) + 2
+    h1 = f * f % NTilde
+    alpha = rng.randbelow(pq - 1) + 1
+    beta = pow(alpha, -1, pq)
+    h2 = pow(h1, alpha, NTilde)
+    key = gen_paillier_key(bits, rng)
+    return PreParams(
+        paillier=key, NTilde=NTilde, h1=h1, h2=h2, alpha=alpha, beta=beta, P=P, Q=Q
+    )
+
+
+# ---------------------------------------------------------------------------
+# batched device kernels
+# ---------------------------------------------------------------------------
+
+
+class PaillierBatch:
+    """Batched Paillier arithmetic for ONE public key over a session axis.
+
+    One node holds one Paillier key (generated in pre-params at startup) and
+    runs B concurrent sessions — so N is a compile-time constant and every
+    ciphertext tensor is (..., 373) limbs mod N². Curve-scalar exponents
+    arrive as (..., n_bits) bit tensors (see bignum.limbs_to_bits).
+    """
+
+    def __init__(self, pk: PaillierPublicKey):
+        self.pk = pk
+        # 11-bit radix family sized to the key (2048-bit keys → the module
+        # PROF_2048/PROF_4096; smaller keys in tests shrink proportionally).
+        # Sized from actual bit lengths: Barrett needs the modulus to occupy
+        # the top limb (N² may have 2b-1 bits).
+        self.prof_n = bn.LimbProfile(bits=11, n_limbs=-(-pk.N.bit_length() // 11))
+        self.prof_n2 = bn.LimbProfile(
+            bits=11, n_limbs=-(-pk.N2.bit_length() // 11)
+        )
+        self.ctx_N2 = bn.BarrettCtx(pk.N2, self.prof_n2)
+        self.ctx_N = bn.BarrettCtx(pk.N, self.prof_n)
+        self.N_limbs = bn.to_limbs(pk.N, self.prof_n)
+        # N⁻¹ mod radix^n for the exact division in L(u) = (u-1)/N
+        r_n = 1 << (self.prof_n.bits * self.prof_n.n_limbs)
+        self.Ninv_limbs = bn.to_limbs(pow(pk.N, -1, r_n), self.prof_n)
+
+    # -- host <-> device ----------------------------------------------------
+
+    def to_limbs_N2(self, xs) -> np.ndarray:
+        return bn.batch_to_limbs(xs, self.prof_n2)
+
+    def from_limbs_N2(self, arr) -> list:
+        return bn.batch_from_limbs(arr, self.prof_n2)
+
+    def to_limbs_N(self, xs) -> np.ndarray:
+        return bn.batch_to_limbs(xs, self.prof_n)
+
+    def from_limbs_N(self, arr) -> list:
+        return bn.batch_from_limbs(arr, self.prof_n)
+
+    # -- kernels ------------------------------------------------------------
+
+    def encrypt(self, m_limbs: jnp.ndarray, r_limbs: jnp.ndarray) -> jnp.ndarray:
+        """c = (1 + mN) · r^N mod N². ``m_limbs`` (..., 187) plaintexts
+        < N; ``r_limbs`` (..., 373) random units mod N (zero-padded)."""
+        N_l = jnp.broadcast_to(
+            jnp.asarray(self.N_limbs), m_limbs.shape[:-1] + (self.prof_n.n_limbs,)
+        )
+        mN = bn.mul_wide(m_limbs, N_l, self.prof_n2)  # < N², one spare limb
+        one_plus = bn.take_limbs(mN, 0, self.prof_n2.n_limbs).at[..., 0].add(1)
+        one_plus = bn.carry(one_plus, self.prof_n2)
+        rN = self.ctx_N2.powmod_const(r_limbs, self.pk.N)
+        return self.ctx_N2.mulmod(one_plus, rN)
+
+    def add(self, c1: jnp.ndarray, c2: jnp.ndarray) -> jnp.ndarray:
+        """Enc(a)·Enc(b) = Enc(a+b mod N)."""
+        return self.ctx_N2.mulmod(c1, c2)
+
+    def scalar_mul(self, c: jnp.ndarray, k_bits: jnp.ndarray) -> jnp.ndarray:
+        """Enc(a)^k = Enc(a·k mod N) with per-session exponent bits."""
+        return self.ctx_N2.powmod(c, k_bits)
+
+    def decrypt(self, sk: PaillierPrivateKey, c: jnp.ndarray) -> jnp.ndarray:
+        """Batched decrypt → (..., 187) plaintext limbs mod N.
+
+        Exact-division form of L: u = c^λ mod N²; (u-1)/N =
+        (u-1)·N⁻¹ mod radix^187 (v < N so the low limbs are exact)."""
+        assert sk.N == self.pk.N
+        n = self.prof_n.n_limbs
+        u = self.ctx_N2.powmod_const(c, sk.lam)
+        u_minus = bn.carry(u.at[..., 0].add(-1), self.prof_n2)
+        lo = bn.take_limbs(u_minus, 0, n)
+        Ninv = jnp.broadcast_to(
+            jnp.asarray(self.Ninv_limbs), lo.shape[:-1] + (n,)
+        )
+        v = bn.mul_wide(lo, Ninv, self.prof_n)[..., :n]
+        mu_l = self.ctx_N.const(sk.mu, v.shape[:-1])
+        return self.ctx_N.mulmod(v, mu_l)

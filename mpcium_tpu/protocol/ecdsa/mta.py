@@ -1,0 +1,139 @@
+"""MtA (Multiplicative-to-Additive) share conversion — the GG18 signing
+workhorse (SURVEY.md §3.3: "MtA … is the dominant per-signature cost and the
+main TPU batching target").
+
+Two parties holding a and b end with α + β ≡ a·b (mod q) without revealing
+their inputs:
+
+  Alice:  cA = Enc_A(a)            + RangeProofAlice (a < q³)
+  Bob:    cB = cA^b · Enc_A(β′)    + RespProofBob (b < q³, β′ committed)
+          β  = −β′ mod q
+  Alice:  α  = Dec_A(cB) mod q     (integer value a·b + β′ < N, no wrap)
+
+The "with check" variant (MtAwc) additionally binds b to a public point
+B = b·G — used when Bob's input is his secret-share summand w_j (GG18 §5).
+
+Host-side reference implementation (python ints). The batched device path
+(engine/ecdsa_batch) evaluates the same equations over limb tensors using
+core.paillier.PaillierBatch.
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ...core import hostmath as hm
+from ...core.paillier import PaillierPrivateKey, PaillierPublicKey
+from .zk import Q, RangeProofAlice, RespProofBob, _rand_unit
+
+
+@dataclass(frozen=True)
+class MtaInit:
+    """Alice → Bob."""
+
+    c_a: int
+    proof: RangeProofAlice
+
+    def to_json(self) -> dict:
+        return {"c_a": str(self.c_a), "proof": self.proof.to_json()}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MtaInit":
+        return cls(c_a=int(d["c_a"]), proof=RangeProofAlice.from_json(d["proof"]))
+
+
+@dataclass(frozen=True)
+class MtaResp:
+    """Bob → Alice."""
+
+    c_b: int
+    proof: RespProofBob
+
+    def to_json(self) -> dict:
+        return {"c_b": str(self.c_b), "proof": self.proof.to_json()}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MtaResp":
+        return cls(c_b=int(d["c_b"]), proof=RespProofBob.from_json(d["proof"]))
+
+
+def mta_init(
+    pk_a: PaillierPublicKey,
+    ntilde_b: int,
+    h1_b: int,
+    h2_b: int,
+    a: int,
+    rng=secrets,
+) -> Tuple[MtaInit, int]:
+    """Alice's first flow. Returns (message, r_a) — r_a is the Paillier
+    randomness, retained for nothing further (kept for tests)."""
+    assert 0 <= a < Q
+    r = _rand_unit(pk_a.N, rng)
+    c_a = pk_a.encrypt(a, r=r)
+    proof = RangeProofAlice.prove(pk_a, ntilde_b, h1_b, h2_b, c_a, a, r, rng=rng)
+    return MtaInit(c_a=c_a, proof=proof), r
+
+
+def mta_respond(
+    pk_a: PaillierPublicKey,
+    ntilde_a: int,
+    h1_a: int,
+    h2_a: int,
+    ntilde_b: int,
+    h1_b: int,
+    h2_b: int,
+    init: MtaInit,
+    b: int,
+    with_check: bool = False,
+    rng=secrets,
+    init_verified: bool = False,
+) -> Tuple[MtaResp, int]:
+    """Bob's flow: verify Alice's proof (under Bob's own ring-Pedersen
+    params), homomorphically evaluate, prove (under Alice's params).
+    Returns (message, β) — Bob's additive share.
+    Raises ValueError if Alice's proof fails.
+
+    ``init_verified=True`` skips re-verifying Alice's proof — for callers
+    that respond to the SAME init twice (γ and w MtAs share one Enc(k));
+    the first call must have verified it."""
+    assert 0 <= b < Q
+    if not init_verified:
+        if not init.proof.verify(pk_a, ntilde_b, h1_b, h2_b, init.c_a):
+            raise ValueError("MtA: Alice's range proof failed")
+        if not 0 < init.c_a < pk_a.N2:
+            raise ValueError("MtA: ciphertext out of range")
+    # β′ ← Z_{q⁵} (GG18 §A.2): large enough to statistically mask a·b mod q,
+    # small enough that a·b + β′ < q⁶ + q⁵ ≪ N never wraps the plaintext ring
+    beta_prime = rng.randbelow(Q**5)
+    r = _rand_unit(pk_a.N, rng)
+    c_beta = pk_a.encrypt(beta_prime, r=r)
+    c_b = pow(init.c_a, b, pk_a.N2) * c_beta % pk_a.N2
+    X = hm.secp_mul(b, hm.SECP_G) if with_check else None
+    proof = RespProofBob.prove(
+        pk_a, ntilde_a, h1_a, h2_a, init.c_a, c_b, b, beta_prime, r, X=X, rng=rng
+    )
+    beta = (-beta_prime) % Q
+    return MtaResp(c_b=c_b, proof=proof), beta
+
+
+def mta_finalize(
+    sk_a: PaillierPrivateKey,
+    ntilde_a: int,
+    h1_a: int,
+    h2_a: int,
+    init: MtaInit,
+    resp: MtaResp,
+    X: Optional[hm.SecpPoint] = None,
+) -> int:
+    """Alice's final flow: verify Bob's proof (under Alice's ring-Pedersen
+    params), decrypt → α. ``X`` enables the with-check binding b·G == X.
+    Raises ValueError on a failing proof."""
+    pk_a = sk_a.public
+    if not resp.proof.verify(
+        pk_a, ntilde_a, h1_a, h2_a, init.c_a, resp.c_b, X=X
+    ):
+        raise ValueError("MtA: Bob's response proof failed")
+    if not 0 < resp.c_b < pk_a.N2:
+        raise ValueError("MtA: response ciphertext out of range")
+    return sk_a.decrypt(resp.c_b) % Q

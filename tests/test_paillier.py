@@ -1,0 +1,124 @@
+"""Paillier host reference + batched device kernels."""
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpcium_tpu.core import bignum as bn
+from mpcium_tpu.core import paillier as pl
+
+
+def small_key(bits=512):
+    return pl.gen_paillier_key(bits)
+
+
+def test_primality_basics():
+    assert pl.is_probable_prime(2**127 - 1)  # Mersenne prime
+    assert not pl.is_probable_prime(2**128 - 1)
+    assert not pl.is_probable_prime(561 * 10**6 + 1 if False else 561)  # Carmichael
+    p = pl.gen_prime(128)
+    assert p.bit_length() == 128 and pl.is_probable_prime(p)
+
+
+def test_safe_prime():
+    p = pl.gen_safe_prime(64)
+    assert pl.is_probable_prime(p) and pl.is_probable_prime((p - 1) // 2)
+
+
+def test_host_roundtrip_and_homomorphism():
+    sk = small_key()
+    pk = sk.public
+    m1 = secrets.randbelow(pk.N)
+    m2 = secrets.randbelow(pk.N)
+    c1, c2 = pk.encrypt(m1), pk.encrypt(m2)
+    assert sk.decrypt(c1) == m1
+    assert sk.decrypt(pk.add(c1, c2)) == (m1 + m2) % pk.N
+    k = secrets.randbelow(2**256)
+    assert sk.decrypt(pk.scalar_mul(c1, k)) == m1 * k % pk.N
+
+
+def test_safe_prime_pool(tmp_path):
+    import json
+    from pathlib import Path
+
+    # the committed fixture pool is loadable
+    fixture = (
+        Path(__file__).resolve().parent.parent
+        / "mpcium_tpu" / "data" / "safeprimes_1024.json"
+    )
+    d = json.load(open(fixture))
+    assert d["bits"] == 1024 and len(d["safe_primes"]) >= 2
+
+    # pool semantics: take consumes, short pool falls back to generation
+    pool = tmp_path / "pool.json"
+    json.dump({"bits": 64, "safe_primes": [str(pl.gen_safe_prime(64))]}, open(pool, "w"))
+    got = pl.pool_take(pool, count=2, bits=64)
+    assert len(got) == 2 and all(pl.is_probable_prime(p) for p in got)
+    assert json.load(open(pool))["safe_primes"] == []  # consumed
+    pp = pl.gen_preparams(bits=128, pool_path=pool)  # regenerates, still works
+    assert pp.NTilde.bit_length() >= 126
+
+
+def test_preparams_structure():
+    P = pl.gen_safe_prime(96)
+    Q = pl.gen_safe_prime(96)
+    while Q == P:
+        Q = pl.gen_safe_prime(96)
+    pp = pl.gen_preparams(bits=192, safe_primes=(P, Q))
+    assert pp.NTilde == P * Q
+    assert pow(pp.h1, pp.alpha, pp.NTilde) == pp.h2
+    assert pow(pp.h2, pp.beta, pp.NTilde) == pp.h1
+    rt = pl.PreParams.from_json(pp.to_json())
+    assert rt == pp
+
+
+@pytest.fixture(scope="module")
+def batch_ctx():
+    sk = small_key(512)
+    return sk, pl.PaillierBatch(sk.public)
+
+
+def test_batch_encrypt_matches_host(batch_ctx):
+    sk, pb = batch_ctx
+    pk = pb.pk
+    B = 4
+    ms = [secrets.randbelow(pk.N) for _ in range(B)]
+    rs = [secrets.randbelow(pk.N - 1) + 1 for _ in range(B)]
+    c = pb.encrypt(jnp.asarray(pb.to_limbs_N(ms)), jnp.asarray(pb.to_limbs_N2(rs)))
+    got = pb.from_limbs_N2(np.asarray(c))
+    expect = [pk.encrypt(m, r=r) for m, r in zip(ms, rs)]
+    assert got == expect
+
+
+def test_batch_decrypt_add_scalar(batch_ctx):
+    sk, pb = batch_ctx
+    pk = pb.pk
+    B = 4
+    m1 = [secrets.randbelow(pk.N) for _ in range(B)]
+    m2 = [secrets.randbelow(pk.N) for _ in range(B)]
+    ks = [secrets.randbelow(2**256) for _ in range(B)]
+    c1 = jnp.asarray(pb.to_limbs_N2([pk.encrypt(m) for m in m1]))
+    c2 = jnp.asarray(pb.to_limbs_N2([pk.encrypt(m) for m in m2]))
+    # batched decrypt
+    got = pb.from_limbs_N(np.asarray(pb.decrypt(sk, c1)))
+    assert got == m1
+    # batched homomorphic add
+    s = pb.from_limbs_N(np.asarray(pb.decrypt(sk, pb.add(c1, c2))))
+    assert s == [(a + b) % pk.N for a, b in zip(m1, m2)]
+    # batched scalar mul with per-session 256-bit exponents
+    k_limbs = jnp.asarray(bn.batch_to_limbs(ks, pb.prof_n))
+    k_bits = bn.limbs_to_bits(k_limbs, pb.prof_n, 256)
+    cm = pb.scalar_mul(c1, k_bits)
+    got = pb.from_limbs_N(np.asarray(pb.decrypt(sk, cm)))
+    assert got == [a * k % pk.N for a, k in zip(m1, ks)]
+
+
+def test_powmod_fixed_base(batch_ctx):
+    sk, pb = batch_ctx
+    base = 0xDEADBEEF
+    es = [secrets.randbelow(2**200) for _ in range(3)]
+    e_limbs = jnp.asarray(bn.batch_to_limbs(es, pb.prof_n))
+    e_bits = bn.limbs_to_bits(e_limbs, pb.prof_n, 200)
+    got = pb.from_limbs_N(np.asarray(pb.ctx_N.powmod_fixed_base(base, e_bits)))
+    assert got == [pow(base, e, pb.pk.N) for e in es]
