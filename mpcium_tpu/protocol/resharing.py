@@ -1,0 +1,458 @@
+"""Committee rotation (resharing) for both curves.
+
+Semantics match the reference (§3.4): an old-committee quorum (≥ t_old+1
+holders) re-deals the SAME secret to a new committee under a new threshold;
+the wallet public key is unchanged; old shares become useless once the new
+committee takes over (`is_reshared` bookkeeping — reference node.go:149-159,
+keyinfo.IsReshared).
+
+Construction (Desmedt–Jajodia style, the standard VSS redeal):
+
+  each old quorum member i computes its Lagrange-weighted additive share
+  w_i = λ_i·x_i  (Σ w_i = secret), then deals a fresh degree-t_new Feldman
+  VSS of w_i to the new committee:
+
+  R1  (old, broadcast)  hash commitment to Feldman points of w_i
+  R2a (old, broadcast)  decommitment; C_i0 MUST equal λ_i·X_i, publicly
+                        recomputable from the OLD aggregated VSS commitments
+                        — binds the redeal to the original wallet key
+  R2b (old, unicast)    sub-share f_i(x'_j) for each new member j
+  R3  (new, broadcast)  confirm: hash of (new pubkey ‖ new agg commitments)
+  finalize              new share x'_j = Σ_i f_i(x'_j); pub unchanged
+
+For secp256k1 the new committee also needs each other's Paillier/ring-
+Pedersen material for future GG18 signing; it rides R3 along with DLN +
+Paillier-validity proofs (this is why the reference's ECDSA resharing has 7
+message types to EdDSA's 5 — pkg/mpc/ecdsa_rounds.go:26-32 vs
+eddsa_rounds.go:26-30).
+
+A party may be old-only (deals, then observes confirms), new-only (receives),
+or both. Old-only parties finish with ``result = None``.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core import hostmath as hm
+from ..core.paillier import PaillierPublicKey, PreParams
+from . import commitments as cm
+from .base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
+
+R1 = "reshare/1/commit"
+R2_DECOMMIT = "reshare/2/decommit"
+R2_SHARE = "reshare/2/share"
+R3_CONFIRM = "reshare/3/confirm"
+
+
+@dataclass(frozen=True)
+class CurveOps:
+    name: str
+    order: int
+    mul: Callable  # (k, point) -> point
+    add: Callable
+    compress: Callable
+    decompress: Callable
+    generator: object
+    identity: object
+
+    def is_identity(self, p) -> bool:
+        if self.name == "secp256k1":
+            return p.is_infinity
+        return p.equals(self.identity)
+
+
+ED_OPS = CurveOps(
+    name="ed25519",
+    order=hm.ED_L,
+    mul=hm.ed_mul,
+    add=hm.ed_add,
+    compress=hm.ed_compress,
+    decompress=hm.ed_decompress,
+    generator=hm.ED_B,
+    identity=hm.ED_IDENT,
+)
+
+SECP_OPS = CurveOps(
+    name="secp256k1",
+    order=hm.SECP_N,
+    mul=hm.secp_mul,
+    add=hm.secp_add,
+    compress=hm.secp_compress,
+    decompress=hm.secp_decompress,
+    generator=hm.SECP_G,
+    identity=hm.SECP_INF,
+)
+
+
+def curve_ops(key_type: str) -> CurveOps:
+    return {"ed25519": ED_OPS, "secp256k1": SECP_OPS}[key_type]
+
+
+class ResharingParty(PartyBase):
+    """One participant of a resharing session.
+
+    ``old_quorum``: the ≥ t_old+1 old holders driving the redeal (must all
+    participate). ``new_committee``: the receivers. ``old_share`` required
+    iff self is in the old quorum. ``preparams`` required iff secp256k1 and
+    self is in the new committee.
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        self_id: str,
+        key_type: str,
+        old_quorum: Sequence[str],
+        new_committee: Sequence[str],
+        new_threshold: int,
+        old_share: Optional[KeygenShare] = None,
+        old_public_key: Optional[bytes] = None,
+        old_vss_commitments: Optional[Sequence[bytes]] = None,
+        preparams: Optional[PreParams] = None,
+        rng=None,
+        min_paillier_bits: int = 2046,
+    ):
+        import secrets as _secrets
+
+        all_ids = sorted(set(old_quorum) | set(new_committee))
+        super().__init__(session_id, self_id, all_ids, rng or _secrets)
+        self.ops = curve_ops(key_type)
+        self.key_type = key_type
+        self.old_quorum = sorted(old_quorum)
+        self.new_committee = sorted(new_committee)
+        self.is_old = self_id in set(old_quorum)
+        self.is_new = self_id in set(new_committee)
+        self.min_paillier_bits = min_paillier_bits
+        if not 0 < new_threshold < len(new_committee):
+            raise ValueError("need 0 < t_new < |new committee|")
+        self.new_threshold = new_threshold
+        self.pre = preparams
+        if self.is_old:
+            if old_share is None:
+                raise ValueError("old-quorum member needs its share")
+            if old_share.key_type != key_type:
+                raise ValueError("share key-type mismatch")
+            self.old_share = old_share
+            old_public_key = old_share.public_key
+            old_vss_commitments = old_share.vss_commitments
+        if old_public_key is None or old_vss_commitments is None:
+            raise ValueError(
+                "new-only members need old_public_key + old_vss_commitments "
+                "(from keyinfo metadata)"
+            )
+        self.old_public_key = old_public_key
+        self.old_agg = [self.ops.decompress(c) for c in old_vss_commitments]
+        if key_type == "secp256k1" and self.is_new and preparams is None:
+            raise ValueError("secp256k1 new-committee member needs preparams")
+
+        # x-coordinate universes
+        if self.is_old:
+            self.old_xs = party_xs(self.old_share.participants)
+            for pid in self.old_quorum:
+                if pid not in self.old_xs:
+                    raise ProtocolError("old member outside keygen universe", pid)
+        else:
+            # any consistent assignment works for verification: old parties'
+            # x-coords derive from the OLD keygen universe which new-only
+            # members learn from keyinfo participants
+            self.old_xs = None  # set lazily from commitments check
+        self.new_xs = party_xs(self.new_committee)
+        self._sent_r2 = False
+        self._sent_r3 = False
+
+    # ------------------------------------------------------------------
+    # NOTE: new-only members must know the old universe to check
+    # C_i0 == λ_i·X_i; it travels in the R1 payload (signed by each old
+    # member, cross-checked for consistency).
+    # ------------------------------------------------------------------
+
+    def start(self) -> List[RoundMsg]:
+        if not self.is_old:
+            return []
+        ops = self.ops
+        q = ops.order
+        quorum_xs = [self.old_xs[p] for p in self.old_quorum]
+        lam = hm.lagrange_coeff(quorum_xs, self.old_xs[self.self_id], q)
+        self._w_i = lam * self.old_share.share % q
+        self._coeffs, self._shares_out = hm.shamir_share(
+            self._w_i,
+            self.new_threshold,
+            [self.new_xs[p] for p in self.new_committee],
+            q,
+            rng=self.rng,
+        )
+        self._points = [
+            ops.compress(ops.mul(c, ops.generator)) for c in self._coeffs
+        ]
+        data = cm.encode_points(self._points)
+        self._commitment, self._blind = cm.commit(data, rng=self.rng)
+        return [
+            self.broadcast(
+                R1,
+                {
+                    "commitment": self._commitment.hex(),
+                    "old_participants": list(self.old_share.participants),
+                },
+            )
+        ]
+
+    def receive(self, msg: RoundMsg) -> List[RoundMsg]:
+        if self.done:
+            return []
+        expect_old = [p for p in self.old_quorum if p != self.self_id]
+        expect_new = [p for p in self.new_committee if p != self.self_id]
+        self._store(msg)
+        out: List[RoundMsg] = []
+
+        if (
+            self.is_old
+            and not self._sent_r2
+            and self._round_full(R1, expect_old)
+        ):
+            self._sent_r2 = True
+            out.append(
+                self.broadcast(
+                    R2_DECOMMIT,
+                    {
+                        "points": [p.hex() for p in self._points],
+                        "blind": self._blind.hex(),
+                    },
+                )
+            )
+            for pid in self.new_committee:
+                if pid == self.self_id:
+                    continue
+                out.append(
+                    self.unicast(
+                        pid,
+                        R2_SHARE,
+                        {"share": str(self._shares_out[self.new_xs[pid]])},
+                    )
+                )
+
+        if (
+            self.is_new
+            and not self._sent_r3
+            and self._round_full(R1, [p for p in self.old_quorum if p != self.self_id])
+            and self._round_full(R2_DECOMMIT, [p for p in self.old_quorum if p != self.self_id])
+            and self._round_full(R2_SHARE, [p for p in self.old_quorum if p != self.self_id])
+        ):
+            self._sent_r3 = True
+            out.append(self._build_confirm())
+
+        if self._round_full(R3_CONFIRM, expect_new) and (
+            not self.is_new or self._sent_r3
+        ):
+            self._finalize()
+        return out
+
+    # -- new-member verification + confirm ----------------------------------
+
+    def _redeal_points(self) -> Dict[str, list]:
+        """Verify decommitments + C_i0 binding; returns per-old-member
+        Feldman points. Requires R1/R2 full (new members only)."""
+        ops = self.ops
+        commits = self._round_payloads(R1)
+        decommits = self._round_payloads(R2_DECOMMIT)
+
+        # establish the old keygen universe consistently
+        old_parts = None
+        for pid in self.old_quorum:
+            if pid == self.self_id:
+                parts = list(self.old_share.participants)
+            else:
+                parts = list(commits[pid]["old_participants"])
+            if old_parts is None:
+                old_parts = parts
+            elif old_parts != parts:
+                raise ProtocolError("inconsistent old-universe claims", pid)
+        old_xs = party_xs(old_parts)
+        for pid in self.old_quorum:
+            if pid not in old_xs:
+                raise ProtocolError("old member outside claimed universe", pid)
+        quorum_xs = [old_xs[p] for p in self.old_quorum]
+
+        all_points: Dict[str, list] = {}
+        for pid in self.old_quorum:
+            if pid == self.self_id:
+                pts = [ops.decompress(p) for p in self._points]
+            else:
+                pts_hex = decommits[pid]["points"]
+                if len(pts_hex) != self.new_threshold + 1:
+                    raise ProtocolError("wrong redeal commitment count", pid)
+                pts_bytes = [bytes.fromhex(p) for p in pts_hex]
+                if not cm.verify(
+                    bytes.fromhex(commits[pid]["commitment"]),
+                    bytes.fromhex(decommits[pid]["blind"]),
+                    cm.encode_points(pts_bytes),
+                ):
+                    raise ProtocolError("redeal decommitment mismatch", pid)
+                try:
+                    pts = [ops.decompress(p) for p in pts_bytes]
+                except ValueError as e:
+                    raise ProtocolError(f"bad redeal point: {e}", pid)
+            # C_i0 must equal λ_i·X_i — the public binding to the old key
+            lam = hm.lagrange_coeff(quorum_xs, old_xs[pid], ops.order)
+            X_i = _eval_commitments_generic(ops, self.old_agg, old_xs[pid])
+            expect = ops.mul(lam, X_i)
+            if ops.compress(pts[0]) != ops.compress(expect):
+                raise ProtocolError("redeal does not match old key share", pid)
+            all_points[pid] = pts
+        return all_points
+
+    def _build_confirm(self) -> RoundMsg:
+        ops = self.ops
+        all_points = self._redeal_points()
+        shares = self._round_payloads(R2_SHARE)
+        my_x = self.new_xs[self.self_id]
+        x_new = 0
+        for pid in self.old_quorum:
+            if pid == self.self_id:
+                s = self._shares_out[my_x]
+            else:
+                s = int(shares[pid]["share"])
+                if not 0 <= s < ops.order:
+                    raise ProtocolError("sub-share out of range", pid)
+                expect = _eval_commitments_generic(ops, all_points[pid], my_x)
+                if ops.compress(ops.mul(s, ops.generator)) != ops.compress(expect):
+                    raise ProtocolError("sub-share VSS verification failed", pid)
+            x_new = (x_new + s) % ops.order
+        # aggregate new VSS commitments
+        agg = []
+        for k in range(self.new_threshold + 1):
+            acc = self.ops.identity
+            for pid in self.old_quorum:
+                acc = ops.add(acc, all_points[pid][k])
+            agg.append(acc)
+        new_pub = ops.compress(agg[0])
+        if new_pub != ops.compress(self.ops.decompress(self.old_public_key)):
+            raise ProtocolError("resharing changed the public key")
+        self._x_new = x_new
+        self._new_agg = [ops.compress(p) for p in agg]
+        digest = hashlib.sha256(
+            b"reshare-confirm" + new_pub + b"".join(self._new_agg)
+        ).hexdigest()
+        payload = {"digest": digest}
+        if self.key_type == "secp256k1":
+            payload.update(self._paillier_payload())
+        return self.broadcast(R3_CONFIRM, payload)
+
+    # -- secp256k1: fresh Paillier material for the new committee -----------
+
+    def _paillier_payload(self) -> dict:
+        from .ecdsa.zk import DLNProof, PaillierProof
+
+        pre = self.pre
+        pq = (pre.P - 1) // 2 * ((pre.Q - 1) // 2)
+        bind = f"{self.session_id}:{self.self_id}".encode()
+        return {
+            "paillier_n": str(pre.paillier.N),
+            "ntilde": str(pre.NTilde),
+            "h1": str(pre.h1),
+            "h2": str(pre.h2),
+            "dln1": DLNProof.prove(
+                pre.h1, pre.h2, pre.alpha, pq, pre.NTilde, self.rng, bind=bind
+            ).to_json(),
+            "dln2": DLNProof.prove(
+                pre.h2, pre.h1, pre.beta, pq, pre.NTilde, self.rng, bind=bind
+            ).to_json(),
+            "paillier_proof": PaillierProof.prove(pre.paillier, bind=bind).to_json(),
+        }
+
+    def _verify_paillier_payload(self, pid: str, p: dict) -> dict:
+        from .ecdsa.zk import DLNProof, PaillierProof
+
+        N = int(p["paillier_n"])
+        ntilde, h1, h2 = int(p["ntilde"]), int(p["h1"]), int(p["h2"])
+        if N.bit_length() < self.min_paillier_bits:
+            raise ProtocolError("Paillier modulus too small", pid)
+        if ntilde.bit_length() < self.min_paillier_bits:
+            raise ProtocolError("NTilde too small", pid)
+        if h1 in (0, 1) or h2 in (0, 1) or h1 == h2:
+            raise ProtocolError("degenerate ring-Pedersen bases", pid)
+        bind = f"{self.session_id}:{pid}".encode()
+        if not DLNProof.from_json(p["dln1"]).verify(h1, h2, ntilde, bind=bind):
+            raise ProtocolError("DLN proof failed", pid)
+        if not DLNProof.from_json(p["dln2"]).verify(h2, h1, ntilde, bind=bind):
+            raise ProtocolError("DLN proof failed", pid)
+        proof = PaillierProof.from_json(p["paillier_proof"])
+        if N.bit_length() >= 2046:
+            if not proof.verify(PaillierPublicKey(N), bind=bind):
+                raise ProtocolError("Paillier validity proof failed", pid)
+        return {"N": N, "ntilde": ntilde, "h1": h1, "h2": h2}
+
+    # -- finalize ------------------------------------------------------------
+
+    def _finalize(self) -> None:
+        confirms = self._round_payloads(R3_CONFIRM)
+        digests = set()
+        peer_material: Dict[str, dict] = {}
+        for pid in self.new_committee:
+            if pid == self.self_id:
+                continue
+            digests.add(confirms[pid]["digest"])
+            if self.key_type == "secp256k1" and self.is_new:
+                peer_material[pid] = self._verify_paillier_payload(
+                    pid, confirms[pid]
+                )
+        if len(digests) > 1:
+            raise ProtocolError("new committee disagrees on reshared key")
+
+        if not self.is_new:
+            self.result = None
+            self.done = True
+            return
+
+        digest = hashlib.sha256(
+            b"reshare-confirm"
+            + self.ops.compress(self.ops.decompress(self.old_public_key))
+            + b"".join(self._new_agg)
+        ).hexdigest()
+        if digests and digests != {digest}:
+            raise ProtocolError("confirm digest mismatch")
+
+        aux = {"is_reshared": True}
+        if self.key_type == "secp256k1":
+            aux.update(
+                {
+                    "paillier_sk": self.pre.paillier.to_json(),
+                    "preparams": {
+                        "ntilde": str(self.pre.NTilde),
+                        "h1": str(self.pre.h1),
+                        "h2": str(self.pre.h2),
+                    },
+                    "peer_paillier": {
+                        pid: str(m["N"]) for pid, m in peer_material.items()
+                    },
+                    "peer_ring_pedersen": {
+                        pid: {
+                            "ntilde": str(m["ntilde"]),
+                            "h1": str(m["h1"]),
+                            "h2": str(m["h2"]),
+                        }
+                        for pid, m in peer_material.items()
+                    },
+                }
+            )
+        self.result = KeygenShare(
+            key_type=self.key_type,
+            share=self._x_new,
+            self_x=self.new_xs[self.self_id],
+            public_key=self.old_public_key
+            if isinstance(self.old_public_key, bytes)
+            else bytes(self.old_public_key),
+            vss_commitments=self._new_agg,
+            participants=list(self.new_committee),
+            threshold=self.new_threshold,
+            aux=aux,
+        )
+        self.done = True
+
+
+def _eval_commitments_generic(ops: CurveOps, points, x: int):
+    acc = ops.identity
+    for pt in reversed(points):
+        acc = ops.add(ops.mul(x, acc), pt)
+    return acc
