@@ -1,0 +1,113 @@
+"""Peer registry / liveness (the Consul `ready/` analogue, pkg/mpc/registry.go).
+
+`ready(node)` writes ``ready/<nodeID>``; a watcher polls the listing at the
+reference's 1 Hz (registry.go:16), maintains the ready map/count, logs
+connect/disconnect transitions, and flips cluster-ready when everyone is
+present (registry.go:68-89). `resign()` removes the key on shutdown
+(registry.go:198-207)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ..store.kvstore import KVStore
+from ..utils import log
+
+READY_PREFIX = "ready/"
+DEFAULT_POLL_S = 1.0  # reference registry.go:16
+
+
+class PeerRegistry:
+    """Reference mpc.PeerRegistry (registry.go:19-27)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        peer_ids: List[str],
+        kv: KVStore,
+        poll_interval_s: float = DEFAULT_POLL_S,
+    ):
+        self.node_id = node_id
+        self.peer_ids = sorted(set(peer_ids) | {node_id})
+        self.kv = kv
+        self.poll_interval_s = poll_interval_s
+        self._ready_map: Set[str] = set()
+        self._cluster_ready = False
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def ready(self) -> None:
+        """Announce readiness (registry.go:93-107)."""
+        self.kv.put(READY_PREFIX + self.node_id, b"true")
+        self._poll_once()
+
+    def resign(self) -> None:
+        """De-register on shutdown (registry.go:198-207)."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self.poll_interval_s + 1)
+        self.kv.delete(READY_PREFIX + self.node_id)
+
+    def watch(self) -> None:
+        """Start the background poll loop (registry.go:109-146)."""
+        if self._thread:
+            return
+        self._thread = threading.Thread(
+            target=self._watch_loop, name=f"registry-{self.node_id}", daemon=True
+        )
+        self._thread.start()
+
+    # -- queries (registry.go:157-196) --------------------------------------
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return len(self._ready_map)
+
+    def ready_peers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ready_map)
+
+    def is_peer_ready(self, peer_id: str) -> bool:
+        with self._lock:
+            return peer_id in self._ready_map
+
+    def all_ready(self) -> bool:
+        with self._lock:
+            return self._cluster_ready
+
+    def wait_all_ready(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self._poll_once()
+            if self.all_ready():
+                return True
+            time.sleep(min(self.poll_interval_s, 0.05))
+        return False
+
+    # -- internals ----------------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self._poll_once()
+
+    def _poll_once(self) -> None:
+        now = {
+            k[len(READY_PREFIX):]
+            for k in self.kv.keys(READY_PREFIX)
+        } & set(self.peer_ids)
+        with self._lock:
+            joined = now - self._ready_map
+            left = self._ready_map - now
+            self._ready_map = now
+            was_ready = self._cluster_ready
+            self._cluster_ready = now == set(self.peer_ids)
+        for p in sorted(joined):
+            log.info("peer ready", peer=p, node=self.node_id)
+        for p in sorted(left):
+            log.warn("peer disconnected!", peer=p, node=self.node_id)  # registry.go:135
+        if self._cluster_ready and not was_ready:
+            log.info("ALL PEERS ARE READY", node=self.node_id)  # registry.go:86

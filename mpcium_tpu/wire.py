@@ -1,0 +1,342 @@
+"""Wire types: signed envelopes and initiator commands.
+
+JSON schemas mirror the reference's `pkg/types` (tss.go:13-24,
+initiator_msg.go) so that results/events are byte-compatible where the
+survey pins them (§7.1 item 4). Canonical signing bytes follow the
+reference's MarshalForSigning contract (types/tss.go:149-163): a sorted-key
+JSON object of the protocol-relevant fields — signatures must not cover
+themselves.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+KEY_TYPE_SECP256K1 = "secp256k1"
+KEY_TYPE_ED25519 = "ed25519"
+
+
+def canonical_json(obj: Any) -> bytes:
+    """Deterministic JSON: sorted keys, no whitespace, UTF-8."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+# ---------------------------------------------------------------------------
+# protocol round envelope (the TssMessage analogue)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Envelope:
+    """Signed protocol-round message (reference types.TssMessage).
+
+    ``session_id`` doubles as the wallet/tx scope; ``payload`` carries the
+    protocol round content (JSON-safe; batched rounds use base64 byte
+    tensors). ``to`` empty ⇒ broadcast.
+    """
+
+    session_id: str
+    round: str
+    from_id: str
+    payload: Dict[str, Any]
+    to: Optional[str] = None
+    is_broadcast: bool = True
+    signature: bytes = b""
+
+    def marshal_for_signing(self) -> bytes:
+        return canonical_json(
+            {
+                "session_id": self.session_id,
+                "round": self.round,
+                "from": self.from_id,
+                "to": self.to or "",
+                "is_broadcast": self.is_broadcast,
+                "payload": self.payload,
+            }
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "session_id": self.session_id,
+            "round": self.round,
+            "from": self.from_id,
+            "to": self.to,
+            "is_broadcast": self.is_broadcast,
+            "payload": self.payload,
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Envelope":
+        return cls(
+            session_id=d["session_id"],
+            round=d["round"],
+            from_id=d["from"],
+            payload=d["payload"],
+            to=d.get("to"),
+            is_broadcast=d.get("is_broadcast", True),
+            signature=bytes.fromhex(d.get("signature", "")),
+        )
+
+    def encode(self) -> bytes:
+        return canonical_json(self.to_json())
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Envelope":
+        return cls.from_json(json.loads(raw))
+
+
+# ---------------------------------------------------------------------------
+# initiator commands (client → nodes)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GenerateKeyMessage:
+    """reference types.GenerateKeyMessage: raw = wallet id bytes."""
+
+    wallet_id: str
+    signature: bytes = b""
+
+    def raw(self) -> bytes:
+        return self.wallet_id.encode()
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"wallet_id": self.wallet_id, "signature": self.signature.hex()}
+
+    @classmethod
+    def from_json(cls, d) -> "GenerateKeyMessage":
+        return cls(
+            wallet_id=d["wallet_id"],
+            signature=bytes.fromhex(d.get("signature", "")),
+        )
+
+
+@dataclass
+class SignTxMessage:
+    """reference types.SignTxMessage (initiator_msg.go:27-34): raw = JSON
+    minus signature (sorted keys)."""
+
+    key_type: str
+    wallet_id: str
+    network_internal_code: str
+    tx_id: str
+    tx: bytes
+
+    signature: bytes = b""
+
+    def raw(self) -> bytes:
+        return canonical_json(
+            {
+                "key_type": self.key_type,
+                "wallet_id": self.wallet_id,
+                "network_internal_code": self.network_internal_code,
+                "tx_id": self.tx_id,
+                "tx": self.tx.hex(),
+            }
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "key_type": self.key_type,
+            "wallet_id": self.wallet_id,
+            "network_internal_code": self.network_internal_code,
+            "tx_id": self.tx_id,
+            "tx": self.tx.hex(),
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_json(cls, d) -> "SignTxMessage":
+        return cls(
+            key_type=d["key_type"],
+            wallet_id=d["wallet_id"],
+            network_internal_code=d["network_internal_code"],
+            tx_id=d["tx_id"],
+            tx=bytes.fromhex(d["tx"]),
+            signature=bytes.fromhex(d.get("signature", "")),
+        )
+
+
+@dataclass
+class ResharingMessage:
+    """reference types.ResharingMessage (initiator_msg.go:36-59)."""
+
+    wallet_id: str
+    new_threshold: int
+    key_type: str
+    signature: bytes = b""
+
+    def raw(self) -> bytes:
+        return canonical_json(
+            {
+                "wallet_id": self.wallet_id,
+                "new_threshold": self.new_threshold,
+                "key_type": self.key_type,
+            }
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "wallet_id": self.wallet_id,
+            "new_threshold": self.new_threshold,
+            "key_type": self.key_type,
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_json(cls, d) -> "ResharingMessage":
+        return cls(
+            wallet_id=d["wallet_id"],
+            new_threshold=int(d["new_threshold"]),
+            key_type=d["key_type"],
+            signature=bytes.fromhex(d.get("signature", "")),
+        )
+
+
+# ---------------------------------------------------------------------------
+# result events (nodes → client), byte-compatible with event/sign.go:21-34
+# ---------------------------------------------------------------------------
+
+RESULT_SUCCESS = "success"
+RESULT_ERROR = "error"
+
+
+@dataclass
+class KeygenSuccessEvent:
+    """reference mpc.KeygenSuccessEvent: one wallet, both curve pubkeys."""
+
+    wallet_id: str
+    ecdsa_pub_key: str  # hex (SEC1 compressed; reference emits raw X||Y)
+    eddsa_pub_key: str  # hex (compressed Edwards)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "wallet_id": self.wallet_id,
+            "ecdsa_pub_key": self.ecdsa_pub_key,
+            "eddsa_pub_key": self.eddsa_pub_key,
+        }
+
+    @classmethod
+    def from_json(cls, d) -> "KeygenSuccessEvent":
+        return cls(
+            wallet_id=d["wallet_id"],
+            ecdsa_pub_key=d["ecdsa_pub_key"],
+            eddsa_pub_key=d["eddsa_pub_key"],
+        )
+
+
+@dataclass
+class SigningResultEvent:
+    """reference event.SigningResultEvent (event/sign.go:21-34)."""
+
+    result_type: str  # success | error
+    wallet_id: str
+    tx_id: str
+    network_internal_code: str = ""
+    error_reason: str = ""
+    is_timeout: bool = False
+    r: str = ""  # hex, ECDSA
+    s: str = ""  # hex, ECDSA
+    signature_recovery: str = ""  # hex byte, ECDSA
+    signature: str = ""  # hex, EdDSA (64-byte R||s)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "result_type": self.result_type,
+            "error_reason": self.error_reason,
+            "is_timeout": self.is_timeout,
+            "network_internal_code": self.network_internal_code,
+            "wallet_id": self.wallet_id,
+            "tx_id": self.tx_id,
+            "r": self.r,
+            "s": self.s,
+            "signature_recovery": self.signature_recovery,
+            "signature": self.signature,
+        }
+
+    @classmethod
+    def from_json(cls, d) -> "SigningResultEvent":
+        return cls(
+            result_type=d["result_type"],
+            wallet_id=d["wallet_id"],
+            tx_id=d["tx_id"],
+            network_internal_code=d.get("network_internal_code", ""),
+            error_reason=d.get("error_reason", ""),
+            is_timeout=bool(d.get("is_timeout", False)),
+            r=d.get("r", ""),
+            s=d.get("s", ""),
+            signature_recovery=d.get("signature_recovery", ""),
+            signature=d.get("signature", ""),
+        )
+
+
+@dataclass
+class ResharingSuccessEvent:
+    """reference mpc.ResharingSuccessEvent (ecdsa_resharing_session.go:40-44)."""
+
+    wallet_id: str
+    new_threshold: int
+    key_type: str
+    pub_key: str  # hex
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "wallet_id": self.wallet_id,
+            "new_threshold": self.new_threshold,
+            "key_type": self.key_type,
+            "pub_key": self.pub_key,
+        }
+
+    @classmethod
+    def from_json(cls, d) -> "ResharingSuccessEvent":
+        return cls(
+            wallet_id=d["wallet_id"],
+            new_threshold=int(d["new_threshold"]),
+            key_type=d["key_type"],
+            pub_key=d["pub_key"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# topics (reference event_consumer.go:24-27, event/sign.go:3-11,
+# pkg/mpc/session.go:40-43)
+# ---------------------------------------------------------------------------
+
+TOPIC_GENERATE = "mpc:generate"
+TOPIC_SIGN = "mpc:sign"
+TOPIC_RESHARE = "mpc:reshare"
+TOPIC_SIGNING_REQUEST = "mpc.signing_request.event"
+TOPIC_KEYGEN_RESULT = "mpc.mpc_keygen_success"
+TOPIC_SIGNING_RESULT = "mpc.signing_result.complete"
+TOPIC_RESHARING_RESULT = "mpc.mpc_resharing_success"
+
+
+def keygen_broadcast_topic(key_type: str, wallet_id: str) -> str:
+    return f"keygen:broadcast:{_kt(key_type)}:{wallet_id}"
+
+
+def keygen_direct_topic(key_type: str, node_id: str, wallet_id: str) -> str:
+    return f"keygen:direct:{_kt(key_type)}:{node_id}:{wallet_id}"
+
+
+def sign_broadcast_topic(key_type: str, wallet_id: str, tx_id: str) -> str:
+    return f"sign:{_kt(key_type)}:broadcast:{wallet_id}:{tx_id}"
+
+
+def sign_direct_topic(key_type: str, node_id: str, tx_id: str) -> str:
+    return f"sign:{_kt(key_type)}:direct:{node_id}:{tx_id}"
+
+
+def resharing_broadcast_topic(key_type: str, wallet_id: str) -> str:
+    return f"resharing:broadcast:{_kt(key_type)}:{wallet_id}"
+
+
+def resharing_direct_topic(key_type: str, node_id: str, wallet_id: str) -> str:
+    return f"resharing:direct:{_kt(key_type)}:{node_id}:{wallet_id}"
+
+
+def _kt(key_type: str) -> str:
+    """Reference uses 'ecdsa'/'eddsa' in topic segments."""
+    return {"secp256k1": "ecdsa", "ed25519": "eddsa"}.get(key_type, key_type)
