@@ -1,0 +1,29 @@
+"""Create a wallet through the client SDK against an in-process 3-node
+cluster (the analogue of reference examples/generate/main.go run against a
+docker-compose stack).
+
+Usage: python examples/generate.py [wallet-id]
+"""
+import sys
+import uuid
+
+from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+from mpcium_tpu.utils import log
+
+
+def main() -> int:
+    wallet_id = sys.argv[1] if len(sys.argv) > 1 else f"wallet-{uuid.uuid4().hex[:8]}"
+    log.init()
+    cluster = LocalCluster(n_nodes=3, threshold=1, preparams=load_test_preparams())
+    try:
+        ev = cluster.create_wallet_sync(wallet_id)
+        print(f"wallet created: {ev.wallet_id}")
+        print(f"  ecdsa (secp256k1) pubkey: {ev.ecdsa_pub_key}")
+        print(f"  eddsa (ed25519)  pubkey: {ev.eddsa_pub_key}")
+        return 0
+    finally:
+        cluster.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

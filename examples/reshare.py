@@ -1,0 +1,48 @@
+"""Create a wallet, rotate its committee, then sign with the reshared
+shares (the analogue of reference examples/reshare — which, per SURVEY.md
+§7.5, does not even compile upstream; this one runs).
+
+Usage: python examples/reshare.py
+"""
+import sys
+import uuid
+
+from mpcium_tpu import wire
+from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.utils import log
+
+
+def main() -> int:
+    log.init()
+    cluster = LocalCluster(n_nodes=3, threshold=1, preparams=load_test_preparams())
+    try:
+        wallet_id = f"wallet-{uuid.uuid4().hex[:8]}"
+        ev = cluster.create_wallet_sync(wallet_id)
+        print(f"wallet {wallet_id} created, eddsa pub {ev.eddsa_pub_key[:16]}…")
+
+        res = cluster.reshare_sync(wallet_id, new_threshold=1, key_type="ed25519")
+        print(f"reshared: pubkey unchanged = {res.pub_key == ev.eddsa_pub_key}")
+
+        tx = b"post-rotation transfer"
+        sres = cluster.sign_sync(
+            wire.SignTxMessage(
+                key_type="ed25519",
+                wallet_id=wallet_id,
+                network_internal_code="solana-devnet",
+                tx_id=f"tx-{uuid.uuid4().hex[:8]}",
+                tx=tx,
+            )
+        )
+        assert sres.result_type == wire.RESULT_SUCCESS, sres.error_reason
+        ok = hm.ed25519_verify(
+            bytes.fromhex(ev.eddsa_pub_key), tx, bytes.fromhex(sres.signature)
+        )
+        print(f"post-rotation signature verified={ok}")
+        return 0
+    finally:
+        cluster.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,7 @@ def _generate_peers(args) -> int:
 
 
 def _register_peers(args) -> int:
-    from mpcium_tpu.registry.filekv import FileKV
+    from mpcium_tpu.store.kvstore import FileKV
 
     with open(args.peers) as f:
         peers = json.load(f)
@@ -59,34 +59,43 @@ def _require_password() -> str:
 
 
 def _generate_identity(args) -> int:
-    from mpcium_tpu.identity.store import generate_node_identity
+    from mpcium_tpu.identity.identity import generate_identity
 
     with open(args.peers) as f:
         peers = json.load(f)
     if args.node not in peers:
         raise SystemExit(f"node {args.node!r} not present in {args.peers}")
     password = _require_password() if args.encrypt else None
-    paths = generate_node_identity(
-        args.identity_dir, args.node, peers[args.node], password=password
+    ident = generate_identity(args.node, args.identity_dir, passphrase=password)
+    print(f"wrote {args.identity_dir}/{args.node}_identity.json")
+    print(
+        f"wrote {args.identity_dir}/{args.node}_private.key"
+        + (".enc" if password else "")
     )
-    for p in paths:
-        print(f"wrote {p}")
+    print(f"public key: {ident.public_key.hex()}")
     return 0
 
 
 def _generate_initiator(args) -> int:
-    from mpcium_tpu.identity.store import generate_initiator_identity
+    from pathlib import Path
+
+    from mpcium_tpu.identity.identity import InitiatorKey
 
     password = _require_password() if args.encrypt else None
+    key = InitiatorKey.generate()
+    out = Path(args.output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    key.save(out / "event_initiator.key", passphrase=password)
     meta = {
+        "public_key": key.public_bytes.hex(),
         "creator": os.environ.get("USER", "unknown"),
         "host": platform.node(),
         "os": f"{platform.system()} {platform.release()}",
         "created_at": datetime.now(timezone.utc).isoformat(),
     }
-    paths = generate_initiator_identity(
-        args.output_dir, password=password, metadata=meta
-    )
-    for p in paths:
-        print(f"wrote {p}")
+    (out / "event_initiator.json").write_text(json.dumps(meta, indent=2))
+    print(f"wrote {out}/event_initiator.key" + (".enc" if password else ""))
+    print(f"wrote {out}/event_initiator.json")
+    print(f"initiator public key: {meta['public_key']}")
+    print("set event_initiator_pubkey to this value in every node's config.yaml")
     return 0

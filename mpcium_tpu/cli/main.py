@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="prompt for passphrase to decrypt the node identity key",
     )
     start.add_argument("--debug", action="store_true")
+    broker = sub.add_parser(
+        "broker", help="run the message broker (the nats-server analogue)"
+    )
+    broker.add_argument("--host", default="127.0.0.1")
+    broker.add_argument("--port", type=int, default=4333)
     sub.add_parser("version", help="print version")
     return p
 
@@ -45,6 +50,10 @@ def main(argv=None) -> int:
             decrypt_private_key=args.decrypt_private_key,
             debug=args.debug,
         )
+    if args.command == "broker":
+        from mpcium_tpu.node.daemon import run_broker
+
+        return run_broker(host=args.host, port=args.port)
     build_parser().print_help()
     return 1
 

@@ -1,0 +1,197 @@
+"""In-process development cluster — the docker-compose-equivalent dev stack.
+
+Assembles n nodes over the loopback fabric with real identities, encrypted
+share stores, registries and consumers, plus a client. This is what the
+reference achieves with NATS + Consul + 3 daemon processes +
+setup_identities.sh (SURVEY.md §2.1 #32); here it is one object for tests,
+examples and local development. Production deployments wire the same
+pieces against the TCP transport and a shared control-plane KV instead.
+"""
+from __future__ import annotations
+
+import json
+import tempfile
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from . import wire
+from .client.client import MPCClient
+from .consumers.event_consumer import EventConsumer
+from .consumers.signing_consumer import SigningConsumer, TimeoutConsumer
+from .core.paillier import PreParams
+from .identity.identity import IdentityStore, InitiatorKey, generate_identity
+from .node.node import Node
+from .registry.registry import PeerRegistry
+from .store.keyinfo import KeyinfoStore
+from .store.kvstore import EncryptedFileKV, MemoryKV
+from .transport.loopback import LoopbackFabric
+from .utils import log
+
+
+class LocalCluster:
+    """n identical in-process MPC nodes + a client over loopback."""
+
+    def __init__(
+        self,
+        n_nodes: int = 3,
+        threshold: int = 2,
+        root_dir: Optional[str] = None,
+        preparams: Optional[Dict[str, PreParams]] = None,
+        store_password: str = "dev-password",
+        min_paillier_bits: int = 2046,
+        reply_timeout_s: float = 30.0,
+        transport: str = "loopback",  # "loopback" | "tcp"
+    ):
+        from .config import init_config
+
+        self.root = Path(root_dir or tempfile.mkdtemp(prefix="mpcium-tpu-"))
+        self.node_ids = [f"node{i}" for i in range(n_nodes)]
+        init_config(path=str(self.root / "nonexistent.yaml"),
+                    mpc_threshold=threshold)
+        self.broker = None
+        if transport == "tcp":
+            from .transport.tcp import BrokerServer, tcp_transport
+
+            self.broker = BrokerServer(port=0)
+            self._mk_transport = lambda: tcp_transport(
+                self.broker.host, self.broker.port
+            )
+            self.fabric = None
+        else:
+            self.fabric = LoopbackFabric()
+            self._mk_transport = self.fabric.transport
+        self.control_kv = MemoryKV()  # the Consul analogue
+
+        # identities (setup_identities.sh equivalent)
+        ident_dir = self.root / "identity"
+        for nid in self.node_ids:
+            generate_identity(nid, ident_dir)
+        self.initiator = InitiatorKey.generate()
+
+        peers = {nid: nid for nid in self.node_ids}
+        self.nodes: Dict[str, Node] = {}
+        self.consumers: List[EventConsumer] = []
+        self.signing_consumers: List[SigningConsumer] = []
+        if preparams is None:
+            preparams = {}
+        for nid in self.node_ids:
+            identity = IdentityStore(
+                ident_dir, nid, peers,
+                initiator_pubkey=self.initiator.public_bytes,
+            )
+            kv = EncryptedFileKV(self.root / "db" / nid, store_password)
+            registry = PeerRegistry(
+                nid, self.node_ids, self.control_kv, poll_interval_s=0.05
+            )
+            transport = self._mk_transport()
+            node = Node(
+                node_id=nid,
+                peer_ids=self.node_ids,
+                transport=transport,
+                identity=identity,
+                kvstore=kv,
+                keyinfo=KeyinfoStore(self.control_kv),
+                registry=registry,
+                preparams=preparams.get(nid),
+                min_paillier_bits=min_paillier_bits,
+            )
+            self.nodes[nid] = node
+            ec = EventConsumer(node, transport)
+            ec.run()
+            self.consumers.append(ec)
+            sc = SigningConsumer(transport, reply_timeout_s=reply_timeout_s)
+            sc.run()
+            self.signing_consumers.append(sc)
+            TimeoutConsumer(transport).run()
+            registry.ready()
+        for node in self.nodes.values():
+            assert node.registry.wait_all_ready(10), "cluster failed to form"
+        log.info("local cluster ready", nodes=n_nodes, threshold=threshold)
+        self.client = MPCClient(self._mk_transport(), self.initiator)
+
+    # -- convenience blocking APIs (examples/tests) -------------------------
+
+    def create_wallet_sync(
+        self, wallet_id: str, timeout_s: float = 600.0
+    ) -> wire.KeygenSuccessEvent:
+        import threading
+
+        done = threading.Event()
+        box: list = []
+
+        sub = self.client.on_wallet_creation_result(
+            lambda ev: (box.append(ev), done.set())
+        )
+        try:
+            self.client.create_wallet(wallet_id)
+            if not done.wait(timeout_s):
+                raise TimeoutError(f"wallet {wallet_id!r} not created in time")
+            if box[0].result_type != wire.RESULT_SUCCESS:
+                raise RuntimeError(f"keygen failed: {box[0].error_reason}")
+            return box[0]
+        finally:
+            sub.unsubscribe()
+
+    def sign_sync(
+        self, msg: wire.SignTxMessage, timeout_s: float = 600.0
+    ) -> wire.SigningResultEvent:
+        import threading
+
+        done = threading.Event()
+        box: list = []
+
+        def on_result(ev: wire.SigningResultEvent):
+            if ev.tx_id == msg.tx_id:
+                box.append(ev)
+                done.set()
+
+        sub = self.client.on_sign_result(on_result)
+        try:
+            self.client.sign_transaction(msg)
+            if not done.wait(timeout_s):
+                raise TimeoutError(f"tx {msg.tx_id!r} not signed in time")
+            return box[0]
+        finally:
+            sub.unsubscribe()
+
+    def reshare_sync(
+        self, wallet_id: str, new_threshold: int, key_type: str,
+        timeout_s: float = 600.0,
+    ) -> wire.ResharingSuccessEvent:
+        import threading
+
+        done = threading.Event()
+        box: list = []
+
+        sub = self.client.on_resharing_result(
+            lambda ev: (box.append(ev), done.set())
+        )
+        try:
+            self.client.resharing(wallet_id, new_threshold, key_type)
+            if not done.wait(timeout_s):
+                raise TimeoutError(f"wallet {wallet_id!r} not reshared in time")
+            if box[0].result_type != wire.RESULT_SUCCESS:
+                raise RuntimeError(f"resharing failed: {box[0].error_reason}")
+            return box[0]
+        finally:
+            sub.unsubscribe()
+
+    def close(self) -> None:
+        for ec in self.consumers:
+            ec.close()
+        for sc in self.signing_consumers:
+            sc.close()
+        for node in self.nodes.values():
+            node.registry.resign()
+        if self.fabric is not None:
+            self.fabric.close()
+        if self.broker is not None:
+            self.broker.close()
+
+
+def load_test_preparams() -> Dict[str, PreParams]:
+    """The committed 2048-bit fixtures (TEST/BENCH ONLY — production nodes
+    generate fresh pre-params, reference node.go:69)."""
+    data_path = Path(__file__).resolve().parent / "data" / "test_preparams.json"
+    d = json.load(open(data_path))["preparams"]
+    return {k: PreParams.from_json(v) for k, v in d.items()}

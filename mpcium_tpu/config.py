@@ -1,0 +1,86 @@
+"""Config system (the viper analogue, reference pkg/config).
+
+`config.yaml` in the working directory (or an explicit path), with
+environment-variable overrides: ``MPCIUM_<KEY>`` where ``.`` → ``_``
+(reference init.go:48-61, e.g. ``MPCIUM_MPC_THRESHOLD=2``). Secrets are
+masked in serialized dumps (init.go:21-33)."""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+_SECRET_KEYS = {"badger_password", "passphrase"}
+
+
+@dataclass
+class AppConfig:
+    mpc_threshold: int = 2
+    environment: str = "development"
+    event_initiator_pubkey: str = ""  # hex
+    badger_password: str = ""
+    identity_dir: str = "identity"
+    db_dir: str = "./db"
+    control_kv_dir: str = "./control"  # FileKV root (the Consul analogue)
+    safe_prime_pool: str = ""
+    passphrase: str = ""  # identity decryption (or prompt)
+    broker_host: str = "127.0.0.1"  # TCP bus (the NATS analogue)
+    broker_port: int = 4333
+    peers_file: str = "peers.json"
+
+    def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if mask_secrets and f.name in _SECRET_KEYS and v:
+                v = "********"
+            out[f.name] = v
+        return out
+
+
+_config: Optional[AppConfig] = None
+_lock = threading.Lock()
+
+
+def init_config(path: Optional[str] = None, **overrides) -> AppConfig:
+    """Load config.yaml + env overrides + explicit overrides."""
+    global _config
+    import yaml
+
+    data: Dict[str, Any] = {}
+    cfg_path = Path(path) if path else Path("config.yaml")
+    if cfg_path.exists():
+        data.update(yaml.safe_load(cfg_path.read_text()) or {})
+    cfg = AppConfig()
+    for f in fields(AppConfig):
+        if f.name in data:
+            setattr(cfg, f.name, type(getattr(cfg, f.name))(data[f.name]))
+        env = os.environ.get("MPCIUM_" + f.name.upper().replace(".", "_"))
+        if env is not None:
+            setattr(cfg, f.name, type(getattr(cfg, f.name))(env))
+    for k, v in overrides.items():
+        if v is not None:
+            setattr(cfg, k, v)
+    with _lock:
+        _config = cfg
+    return cfg
+
+
+def get_config() -> AppConfig:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = AppConfig()
+        return _config
+
+
+def check_required(cfg: AppConfig, keys) -> None:
+    """Reference checkRequiredConfigValues (main.go:278-288)."""
+    missing = [k for k in keys if not getattr(cfg, k, None)]
+    if missing:
+        raise SystemExit(
+            f"missing required config values: {', '.join(missing)} "
+            f"(set in config.yaml or MPCIUM_<KEY> env)"
+        )

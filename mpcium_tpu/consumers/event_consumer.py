@@ -1,0 +1,402 @@
+"""Event consumers — the node's application brain (reference
+pkg/eventconsumer/event_consumer.go).
+
+Subscribes to the three command topics, verifies initiator signatures,
+spawns sessions, publishes results:
+
+- keygen: one wallet-creation event drives BOTH curves' DKG concurrently;
+  a single KeygenSuccessEvent carries both pubkeys (event_consumer.go:
+  103-204).
+- signing: dup-session check on walletID-txID (event_consumer.go:234-238),
+  NotEnoughParticipants ⇒ raise for queue redelivery (276-280), success ⇒
+  idempotent result enqueue + reply-inbox publish (327-337), failure ⇒
+  error result event.
+- resharing: one dual-role resharing session per node, result aggregated
+  (375-518).
+- stale-session GC (default 30 min timeout / 5 min sweep,
+  event_consumer.go:71-72).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import wire
+from ..node.node import Node, NotEnoughParticipants
+from ..node.session import Session
+from ..transport.api import Transport
+from ..utils import log
+
+SESSION_TIMEOUT_S = 30 * 60  # event_consumer.go:71
+GC_INTERVAL_S = 5 * 60  # event_consumer.go:72
+
+
+class EventConsumer:
+    def __init__(
+        self,
+        node: Node,
+        transport: Transport,
+        session_timeout_s: float = SESSION_TIMEOUT_S,
+        gc_interval_s: float = GC_INTERVAL_S,
+    ):
+        self.node = node
+        self.transport = transport
+        self.session_timeout_s = session_timeout_s
+        self.gc_interval_s = gc_interval_s
+        self._sessions: Dict[str, list] = {}  # dedup key -> [Session]
+        self._lock = threading.RLock()
+        self._subs = []
+        self._gc_stop = threading.Event()
+        self._gc_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> None:
+        ps = self.transport.pubsub
+        self._subs.append(ps.subscribe(wire.TOPIC_GENERATE, self._on_generate))
+        self._subs.append(ps.subscribe(wire.TOPIC_SIGN, self._on_sign))
+        self._subs.append(ps.subscribe(wire.TOPIC_RESHARE, self._on_reshare))
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, name=f"session-gc-{self.node.node_id}", daemon=True
+        )
+        self._gc_thread.start()
+
+    def close(self) -> None:
+        self._gc_stop.set()
+        for s in self._subs:
+            s.unsubscribe()
+        with self._lock:
+            for sessions in self._sessions.values():
+                for s in sessions:
+                    s.close()
+            self._sessions.clear()
+
+    # -- keygen -------------------------------------------------------------
+
+    def _on_generate(self, raw: bytes) -> None:
+        try:
+            msg = wire.GenerateKeyMessage.from_json(json.loads(raw))
+        except Exception as e:  # noqa: BLE001
+            log.warn("bad generate event", error=repr(e))
+            return
+        if not self.node.identity.verify_initiator(msg.raw(), msg.signature):
+            log.warn("generate event with BAD initiator signature dropped",
+                     wallet=msg.wallet_id)
+            return
+        wallet_id = msg.wallet_id
+        dedup = f"keygen-{wallet_id}"
+        if not self._claim(dedup):
+            log.info("duplicate keygen event ignored", wallet=wallet_id)
+            return
+        threshold = self._threshold()
+        results: Dict[str, bytes] = {}
+        errors: list = []
+        done = threading.Event()
+
+        def mk_done(kt):
+            def _done(share):
+                results[kt] = share.public_key
+                if len(results) == 2:
+                    done.set()
+            return _done
+
+        def mk_err(kt):
+            def _err(e):
+                errors.append((kt, e))
+                done.set()  # real error propagation, not a hung WaitGroup
+                             # (reference wart §7.5: error goroutines never
+                             # abort the WaitGroup)
+            return _err
+
+        def emit_keygen_error(reason: str):
+            ev = wire.KeygenSuccessEvent(
+                wallet_id=wallet_id, ecdsa_pub_key="", eddsa_pub_key="",
+                result_type=wire.RESULT_ERROR, error_reason=reason,
+            )
+            self.transport.queues.enqueue(
+                f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}",
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=f"{wallet_id}-err",
+            )
+
+        try:
+            sessions = []
+            for kt in (wire.KEY_TYPE_SECP256K1, wire.KEY_TYPE_ED25519):
+                s = self.node.create_keygen_session(
+                    kt, wallet_id, threshold,
+                    on_done=mk_done(kt), on_error=mk_err(kt),
+                )
+                sessions.append(s)
+        except NotEnoughParticipants as e:
+            log.warn("keygen: cluster not ready", wallet=wallet_id, error=str(e))
+            emit_keygen_error(f"cluster not ready: {e}")
+            self._release(dedup)
+            return
+        self._track(dedup, sessions)
+        for s in sessions:
+            s.listen()
+
+        def waiter():
+            finished = done.wait(self.session_timeout_s)
+            try:
+                if errors or len(results) != 2:
+                    log.error("keygen failed", wallet=wallet_id,
+                              errors=repr(errors))
+                    reason = (
+                        "; ".join(f"{kt}: {e}" for kt, e in errors)
+                        if errors
+                        else ("timed out" if not finished else "incomplete")
+                    )
+                    emit_keygen_error(reason)
+                    return
+                event = wire.KeygenSuccessEvent(
+                    wallet_id=wallet_id,
+                    ecdsa_pub_key=results[wire.KEY_TYPE_SECP256K1].hex(),
+                    eddsa_pub_key=results[wire.KEY_TYPE_ED25519].hex(),
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}",
+                    wire.canonical_json(event.to_json()),
+                    idempotency_key=wallet_id,
+                )
+                log.info("wallet created", wallet=wallet_id,
+                         node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    # -- signing ------------------------------------------------------------
+
+    def _on_sign(self, raw: bytes) -> None:
+        """Handles mpc:sign — wrapped by publish_with_reply, so the payload
+        carries the reply inbox."""
+        try:
+            outer = json.loads(raw)
+            reply_topic = outer.get("reply", "")
+            msg = wire.SignTxMessage.from_json(
+                json.loads(bytes.fromhex(outer["data"]))
+            )
+        except Exception:
+            # tolerate un-wrapped direct publishes too
+            try:
+                msg = wire.SignTxMessage.from_json(json.loads(raw))
+                reply_topic = ""
+            except Exception as e:  # noqa: BLE001
+                log.warn("bad sign event", error=repr(e))
+                return
+        if not self.node.identity.verify_initiator(msg.raw(), msg.signature):
+            log.warn("sign event with BAD initiator signature dropped",
+                     wallet=msg.wallet_id, tx=msg.tx_id)
+            return
+        dedup = f"{msg.wallet_id}-{msg.tx_id}"
+        if not self._claim(dedup):
+            log.info("duplicate signing session ignored", key=dedup)
+            return
+
+        def emit_error(reason: str, timeout: bool = False):
+            ev = wire.SigningResultEvent(
+                result_type=wire.RESULT_ERROR,
+                wallet_id=msg.wallet_id,
+                tx_id=msg.tx_id,
+                network_internal_code=msg.network_internal_code,
+                error_reason=reason,
+                is_timeout=timeout,
+            )
+            self.transport.queues.enqueue(
+                wire.TOPIC_SIGNING_RESULT,
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=msg.tx_id,
+            )
+            # terminal error: ack the reply inbox so the durable bridge
+            # doesn't burn its full timeout before acking (the reference
+            # error path Acks the stream message, event_consumer.go:349-373)
+            if reply_topic:
+                self.transport.pubsub.publish(reply_topic, b"ERR")
+
+        def on_done(result):
+            try:
+                if msg.key_type == wire.KEY_TYPE_SECP256K1:
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        r=format(result["r"], "x"),
+                        s=format(result["s"], "x"),
+                        signature_recovery=format(result["recovery"], "02x"),
+                    )
+                else:
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        signature=result.hex(),
+                    )
+                self.transport.queues.enqueue(
+                    wire.TOPIC_SIGNING_RESULT,
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=msg.tx_id,
+                )
+                if reply_topic:
+                    self.transport.pubsub.publish(reply_topic, b"OK")
+                log.info("tx signed", wallet=msg.wallet_id, tx=msg.tx_id,
+                         node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        def on_error(e):
+            emit_error(str(e))
+            self._finish(dedup)
+
+        try:
+            session = self.node.create_signing_session(
+                msg.key_type, msg.wallet_id, msg.tx_id, msg.tx,
+                on_done=on_done, on_error=on_error,
+            )
+        except NotEnoughParticipants as e:
+            # no reply ⇒ the durable bridge times out, naks, and the queue
+            # redelivers (event_consumer.go:276-280 leaves the event
+            # un-acked for exactly this retry)
+            log.warn("signing retryable", wallet=msg.wallet_id,
+                     tx=msg.tx_id, reason=str(e))
+            self._release(dedup)
+            return
+        except Exception as e:  # noqa: BLE001
+            log.error("signing session init failed", error=str(e))
+            emit_error(str(e))
+            self._release(dedup)
+            return
+        if session is None:
+            # not in quorum — other nodes will sign. Do NOT reply: an early
+            # OK would ack the durable request before any quorum node has
+            # committed, killing the redelivery path when quorum nodes bail
+            # retryably.
+            self._release(dedup)
+            return
+        self._track(dedup, [session])
+        session.listen()
+
+    # -- resharing ----------------------------------------------------------
+
+    def _on_reshare(self, raw: bytes) -> None:
+        try:
+            msg = wire.ResharingMessage.from_json(json.loads(raw))
+        except Exception as e:  # noqa: BLE001
+            log.warn("bad reshare event", error=repr(e))
+            return
+        if not self.node.identity.verify_initiator(msg.raw(), msg.signature):
+            log.warn("reshare event with BAD initiator signature dropped",
+                     wallet=msg.wallet_id)
+            return
+        dedup = f"reshare-{msg.key_type}-{msg.wallet_id}"
+        if not self._claim(dedup):
+            return
+
+        def on_done(share):
+            try:
+                if share is None:
+                    return  # old-only member
+                ev = wire.ResharingSuccessEvent(
+                    wallet_id=msg.wallet_id,
+                    new_threshold=msg.new_threshold,
+                    key_type=msg.key_type,
+                    pub_key=share.public_key.hex(),
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_RESHARING_RESULT}.{msg.wallet_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=f"{msg.wallet_id}-{msg.key_type}",
+                )
+                log.info("wallet reshared", wallet=msg.wallet_id,
+                         key_type=msg.key_type, node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        def emit_reshare_error(reason: str):
+            ev = wire.ResharingSuccessEvent(
+                wallet_id=msg.wallet_id, new_threshold=msg.new_threshold,
+                key_type=msg.key_type, pub_key="",
+                result_type=wire.RESULT_ERROR, error_reason=reason,
+            )
+            self.transport.queues.enqueue(
+                f"{wire.TOPIC_RESHARING_RESULT}.{msg.wallet_id}",
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=f"{msg.wallet_id}-{msg.key_type}-err",
+            )
+
+        def on_error(e):
+            log.error("resharing failed", wallet=msg.wallet_id, error=str(e))
+            emit_reshare_error(str(e))
+            self._finish(dedup)
+
+        try:
+            session = self.node.create_resharing_session(
+                msg.key_type, msg.wallet_id, msg.new_threshold,
+                on_done=on_done, on_error=on_error,
+            )
+        except NotEnoughParticipants as e:
+            # mpc:reshare is an ephemeral command (no durable retry path,
+            # matching the reference) — surface a terminal error event so
+            # the initiator is not left waiting
+            log.warn("resharing: not enough participants", error=str(e))
+            emit_reshare_error(str(e))
+            self._release(dedup)
+            return
+        except Exception as e:  # noqa: BLE001
+            log.error("resharing session init failed", error=str(e))
+            emit_reshare_error(str(e))
+            self._release(dedup)
+            return
+        self._track(dedup, [session])
+        session.listen()
+
+    # -- session bookkeeping (event_consumer.go:49-53, 550-573) -------------
+
+    def _claim(self, key: str) -> bool:
+        with self._lock:
+            if key in self._sessions:
+                return False
+            self._sessions[key] = []
+            return True
+
+    def _track(self, key: str, sessions) -> None:
+        with self._lock:
+            self._sessions[key] = list(sessions)
+
+    def _release(self, key: str) -> None:
+        with self._lock:
+            self._sessions.pop(key, None)
+
+    def _finish(self, key: str) -> None:
+        with self._lock:
+            sessions = self._sessions.pop(key, [])
+        for s in sessions:
+            s.close()
+
+    def _threshold(self) -> int:
+        from ..config import get_config
+
+        return get_config().mpc_threshold
+
+    # -- GC (event_consumer.go:520-547) -------------------------------------
+
+    def _gc_loop(self) -> None:
+        while not self._gc_stop.wait(self.gc_interval_s):
+            now = time.monotonic()
+            stale = []
+            with self._lock:
+                for key, sessions in list(self._sessions.items()):
+                    if any(
+                        now - s.last_activity > self.session_timeout_s
+                        for s in sessions
+                    ):
+                        stale.append(key)
+                        for s in sessions:
+                            s.close()
+                        del self._sessions[key]
+            for key in stale:
+                log.warn("stale session reaped", key=key, node=self.node.node_id)

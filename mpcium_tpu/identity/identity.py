@@ -44,6 +44,17 @@ class IdentityError(Exception):
     pass
 
 
+def _write_private_file(path, data: bytes) -> None:
+    """Create/overwrite a key file with 0600 permissions — signing keys must
+    not be world-readable on multi-user hosts."""
+    p = Path(path)
+    fd = os.open(str(p), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
 def _derive_key(passphrase: str, salt: bytes) -> bytes:
     return hashlib.scrypt(
         passphrase.encode(), salt=salt, n=_SCRYPT_N, r=_SCRYPT_R, p=_SCRYPT_P,
@@ -108,11 +119,12 @@ def generate_identity(
             raise IdentityError(
                 "passphrase must be ≥12 chars and contain a special character"
             )
-        Path(str(key_path) + ENC_SUFFIX).write_bytes(
-            encrypt_private_bytes(raw.hex().encode(), passphrase)
+        _write_private_file(
+            str(key_path) + ENC_SUFFIX,
+            encrypt_private_bytes(raw.hex().encode(), passphrase),
         )
     else:
-        key_path.write_text(raw.hex())
+        _write_private_file(key_path, raw.hex().encode())
     return ident
 
 
@@ -237,11 +249,12 @@ class InitiatorKey:
             serialization.NoEncryption(),
         )
         if passphrase is not None:
-            Path(str(path) + ENC_SUFFIX).write_bytes(
-                encrypt_private_bytes(raw.hex().encode(), passphrase)
+            _write_private_file(
+                str(path) + ENC_SUFFIX,
+                encrypt_private_bytes(raw.hex().encode(), passphrase),
             )
         else:
-            Path(path).write_text(raw.hex())
+            _write_private_file(path, raw.hex().encode())
 
     @property
     def public_bytes(self) -> bytes:

@@ -1,0 +1,133 @@
+"""Node daemon: the `mpcium start -n node0` equivalent (cmd/mpcium/main.go).
+
+Wires every subsystem by hand like the reference (main.go:86-200): config →
+logging → control-plane KV → encrypted share store → keyinfo → identity →
+TCP bus transport → registry → node (pre-params) → event consumer +
+timeout consumer → ready → signing consumer, then blocks until
+SIGINT/SIGTERM.
+"""
+from __future__ import annotations
+
+import getpass
+import json
+import signal
+import threading
+from pathlib import Path
+
+from ..config import check_required, get_config, init_config
+from ..consumers.event_consumer import EventConsumer
+from ..consumers.signing_consumer import SigningConsumer, TimeoutConsumer
+from ..identity.identity import IdentityStore
+from ..registry.registry import PeerRegistry
+from ..store.keyinfo import KeyinfoStore
+from ..store.kvstore import EncryptedFileKV, FileKV
+from ..transport.tcp import tcp_transport
+from ..utils import log
+from .node import Node
+
+
+def load_peers(cfg) -> dict:
+    """peers.json {name: uuid} (reference generate-peers.go), else the
+    control-plane ``mpc_peers/`` prefix (reference LoadPeersFromConsul,
+    main.go:302-311)."""
+    p = Path(cfg.peers_file)
+    if p.exists():
+        return json.loads(p.read_text())
+    kv = FileKV(cfg.control_kv_dir)
+    peers = {}
+    for key in kv.keys("mpc_peers/"):
+        peers[key[len("mpc_peers/"):]] = (kv.get(key) or b"").decode()
+    if not peers:
+        raise SystemExit(
+            f"no peers: neither {cfg.peers_file} nor mpc_peers/ in "
+            f"{cfg.control_kv_dir} (run mpcium-tpu-cli generate-peers + "
+            f"register-peers first)"
+        )
+    return peers
+
+
+def run_node(
+    name: str,
+    config_path: str = "config.yaml",
+    decrypt_private_key: bool = False,
+    debug: bool = False,
+    block: bool = True,
+):
+    cfg = init_config(config_path)
+    log.init(
+        production=cfg.environment == "production",
+        level="DEBUG" if debug else "INFO",
+    )
+    check_required(cfg, ["badger_password", "event_initiator_pubkey"])
+    passphrase = cfg.passphrase or None
+    if decrypt_private_key and passphrase is None:
+        passphrase = getpass.getpass(f"passphrase for {name} identity key: ")
+
+    peers = load_peers(cfg)
+    if name not in peers:
+        raise SystemExit(f"node {name!r} not in peer set {sorted(peers)}")
+
+    control_kv = FileKV(cfg.control_kv_dir)
+    share_store = EncryptedFileKV(Path(cfg.db_dir) / name, cfg.badger_password)
+    keyinfo = KeyinfoStore(control_kv)
+    identity = IdentityStore(
+        cfg.identity_dir,
+        name,
+        peers,
+        initiator_pubkey=bytes.fromhex(cfg.event_initiator_pubkey),
+        passphrase=passphrase,
+    )
+    transport = tcp_transport(cfg.broker_host, cfg.broker_port)
+    registry = PeerRegistry(name, list(peers), control_kv)
+    node = Node(
+        node_id=name,
+        peer_ids=list(peers),
+        transport=transport,
+        identity=identity,
+        kvstore=share_store,
+        keyinfo=keyinfo,
+        registry=registry,
+        safe_prime_pool=cfg.safe_prime_pool or None,
+    )
+    consumer = EventConsumer(node, transport)
+    consumer.run()
+    TimeoutConsumer(transport).run()
+    registry.ready()
+    signing = SigningConsumer(transport)
+    signing.run()
+    log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")
+
+    if not block:
+        return node, consumer, signing, registry
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    stop.wait()
+    log.info("shutting down", node=name)
+    signing.close()
+    consumer.close()
+    registry.resign()
+    transport.client.close()
+    return 0
+
+
+def run_broker(host: str = "127.0.0.1", port: int = 4333, block: bool = True):
+    """The `nats-server` analogue: `mpcium-tpu broker`."""
+    from ..transport.tcp import BrokerServer
+
+    broker = BrokerServer(host=host, port=port)
+    log.init()
+    log.info("broker listening", host=broker.host, port=broker.port)
+    if not block:
+        return broker
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    broker.close()
+    return 0

@@ -1,0 +1,274 @@
+"""MPC node core: session factories + share persistence.
+
+The reference's `mpc.Node` (pkg/mpc/node.go): holds identity/transport/
+stores, generates ECDSA pre-params once at startup (node.go:69 — here
+loadable from a safe-prime pool file so restarts are instant), and exposes
+six factories (ECDSA/EdDSA × keygen/signing/resharing). Share persistence
+uses ``ecdsa:<walletID>`` / ``eddsa:<walletID>`` store keys
+(session.go:40-43); wallet metadata goes to the keyinfo store.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional, Sequence
+
+from .. import wire
+from ..core import hostmath as hm
+from ..core.paillier import PreParams, gen_preparams
+from ..identity.identity import IdentityStore
+from ..protocol.base import KeygenShare, ProtocolError
+from ..protocol.ecdsa.keygen import ECDSAKeygenParty
+from ..protocol.ecdsa.signing import ECDSASigningParty
+from ..protocol.eddsa.keygen import EDDSAKeygenParty
+from ..protocol.eddsa.signing import EDDSASigningParty
+from ..protocol.resharing import ResharingParty
+from ..registry.registry import PeerRegistry
+from ..store.keyinfo import KeyInfo, KeyinfoStore
+from ..store.kvstore import KVStore
+from ..transport.api import Transport
+from ..utils import log
+from .session import Session
+
+ERR_NOT_ENOUGH_PARTICIPANTS = "not enough participants"
+
+
+class NotEnoughParticipants(Exception):
+    """Signing with a partial cluster — retryable (reference
+    ErrNotEnoughParticipants, session.go:22, event_consumer.go:276-280)."""
+
+
+def share_key(key_type: str, wallet_id: str) -> str:
+    kt = {"secp256k1": "ecdsa", "ed25519": "eddsa"}.get(key_type, key_type)
+    return f"{kt}:{wallet_id}"
+
+
+class Node:
+    def __init__(
+        self,
+        node_id: str,
+        peer_ids: Sequence[str],
+        transport: Transport,
+        identity: IdentityStore,
+        kvstore: KVStore,
+        keyinfo: KeyinfoStore,
+        registry: PeerRegistry,
+        preparams: Optional[PreParams] = None,
+        safe_prime_pool: Optional[str] = None,
+        min_paillier_bits: int = 2046,
+    ):
+        self.node_id = node_id
+        self.peer_ids = sorted(set(peer_ids) | {node_id})
+        self.transport = transport
+        self.identity = identity
+        self.kvstore = kvstore
+        self.keyinfo = keyinfo
+        self.registry = registry
+        self.min_paillier_bits = min_paillier_bits
+        # ECDSA pre-params once at startup (reference node.go:69); the pool
+        # file makes this seconds instead of minutes
+        if preparams is None:
+            log.info("generating ECDSA pre-params", node=node_id)
+            preparams = gen_preparams(pool_path=safe_prime_pool)
+            log.info("pre-params ready", node=node_id)
+        self.preparams = preparams
+        self.registry.watch()
+
+    # -- persistence --------------------------------------------------------
+
+    def save_share(self, share: KeygenShare, wallet_id: str) -> None:
+        self.kvstore.put(
+            share_key(share.key_type, wallet_id),
+            json.dumps(share.to_json()).encode(),
+        )
+        self.keyinfo.save(
+            share.key_type,
+            wallet_id,
+            KeyInfo(
+                participant_peer_ids=share.participants,
+                threshold=share.threshold,
+                is_reshared=bool(share.aux.get("is_reshared", False)),
+                public_key=share.public_key.hex(),
+                vss_commitments=[c.hex() for c in share.vss_commitments],
+            ),
+        )
+
+    def load_share(self, key_type: str, wallet_id: str) -> KeygenShare:
+        raw = self.kvstore.get(share_key(key_type, wallet_id))
+        if raw is None:
+            raise ProtocolError(f"no {key_type} share for wallet {wallet_id!r}")
+        return KeygenShare.from_json(json.loads(raw))
+
+    # -- quorum selection ---------------------------------------------------
+
+    def _ready_quorum(self, participants: Sequence[str], need: int) -> list:
+        ready = set(self.registry.ready_peers())
+        quorum = sorted(set(participants) & ready)
+        if len(quorum) < need:
+            raise NotEnoughParticipants(
+                f"{len(quorum)}/{need} ready among {sorted(participants)}"
+            )
+        return quorum
+
+    # -- keygen -------------------------------------------------------------
+
+    def create_keygen_session(
+        self,
+        key_type: str,
+        wallet_id: str,
+        threshold: int,
+        on_done: Optional[Callable] = None,
+        on_error: Optional[Callable] = None,
+    ) -> Session:
+        # keygen requires the full configured cluster (reference node.go:95)
+        if self.registry.ready_count() < len(self.peer_ids):
+            raise NotEnoughParticipants(
+                f"{self.registry.ready_count()}/{len(self.peer_ids)} ready"
+            )
+        participants = list(self.peer_ids)
+        session_id = f"keygen:{wire._kt(key_type)}:{wallet_id}"
+        if key_type == wire.KEY_TYPE_SECP256K1:
+            party = ECDSAKeygenParty(
+                session_id, self.node_id, participants, threshold,
+                preparams=self.preparams,
+                min_paillier_bits=self.min_paillier_bits,
+            )
+        else:
+            party = EDDSAKeygenParty(
+                session_id, self.node_id, participants, threshold
+            )
+
+        def persist_and_done(share: KeygenShare):
+            self.save_share(share, wallet_id)
+            if on_done:
+                on_done(share)
+
+        return Session(
+            session_id=session_id,
+            party=party,
+            node_id=self.node_id,
+            participants=participants,
+            transport=self.transport,
+            identity=self.identity,
+            broadcast_topic=wire.keygen_broadcast_topic(key_type, wallet_id),
+            direct_topic_fn=lambda n: wire.keygen_direct_topic(key_type, n, wallet_id),
+            on_done=persist_and_done,
+            on_error=on_error,
+        )
+
+    # -- signing ------------------------------------------------------------
+
+    def create_signing_session(
+        self,
+        key_type: str,
+        wallet_id: str,
+        tx_id: str,
+        tx: bytes,
+        on_done: Optional[Callable] = None,
+        on_error: Optional[Callable] = None,
+    ) -> Optional[Session]:
+        """Returns None when this node is not in the selected quorum."""
+        info = self.keyinfo.get(key_type, wallet_id)
+        if info is None:
+            # unknown OR keygen still persisting on this node — retryable;
+            # truly unknown wallets exhaust redelivery and surface as a
+            # dead-letter timeout (reference redelivery philosophy,
+            # event_consumer.go:276-280)
+            raise NotEnoughParticipants(
+                f"no {key_type} metadata for wallet {wallet_id!r} (yet)"
+            )
+        quorum = self._ready_quorum(info.participant_peer_ids, info.threshold + 1)
+        if self.node_id not in quorum:
+            return None
+        try:
+            share = self.load_share(key_type, wallet_id)
+        except ProtocolError:
+            raise NotEnoughParticipants(
+                f"no {key_type} share for wallet {wallet_id!r} (yet)"
+            )
+        session_id = f"sign:{wire._kt(key_type)}:{wallet_id}:{tx_id}"
+        if key_type == wire.KEY_TYPE_SECP256K1:
+            digest = int.from_bytes(tx, "big")
+            party = ECDSASigningParty(
+                session_id, self.node_id, quorum, share, digest
+            )
+        else:
+            party = EDDSASigningParty(
+                session_id, self.node_id, quorum, share, tx
+            )
+        return Session(
+            session_id=session_id,
+            party=party,
+            node_id=self.node_id,
+            participants=quorum,
+            transport=self.transport,
+            identity=self.identity,
+            broadcast_topic=wire.sign_broadcast_topic(key_type, wallet_id, tx_id),
+            direct_topic_fn=lambda n: wire.sign_direct_topic(key_type, n, tx_id),
+            on_done=on_done,
+            on_error=on_error,
+        )
+
+    # -- resharing ----------------------------------------------------------
+
+    def create_resharing_session(
+        self,
+        key_type: str,
+        wallet_id: str,
+        new_threshold: int,
+        on_done: Optional[Callable] = None,
+        on_error: Optional[Callable] = None,
+    ) -> Session:
+        """Every ready node participates: old-quorum members re-deal, the
+        new committee (= all ready nodes) receives. One party object plays
+        both roles where they overlap (reference runs two sessions,
+        §3.4 — the single dual-role party is the cleaner equivalent)."""
+        info = self.keyinfo.get(key_type, wallet_id)
+        if info is None:
+            raise ProtocolError(f"unknown wallet {wallet_id!r} ({key_type})")
+        old_quorum = self._ready_quorum(
+            info.participant_peer_ids, info.threshold + 1
+        )[: info.threshold + 1]
+        new_committee = self.registry.ready_peers()
+        if len(new_committee) < new_threshold + 1:
+            raise NotEnoughParticipants(
+                f"{len(new_committee)} ready < new threshold {new_threshold}+1"
+            )
+        is_old = self.node_id in old_quorum
+        old_share = (
+            self.load_share(key_type, wallet_id) if is_old else None
+        )
+        session_id = f"resharing:{wire._kt(key_type)}:{wallet_id}"
+        party = ResharingParty(
+            session_id,
+            self.node_id,
+            key_type,
+            old_quorum,
+            new_committee,
+            new_threshold,
+            old_share=old_share,
+            old_public_key=bytes.fromhex(info.public_key) if info.public_key else None,
+            old_vss_commitments=[bytes.fromhex(c) for c in info.vss_commitments]
+            or None,
+            preparams=self.preparams if key_type == wire.KEY_TYPE_SECP256K1 else None,
+            min_paillier_bits=self.min_paillier_bits,
+        )
+
+        def persist_and_done(share):
+            if share is not None:  # new-committee member
+                self.save_share(share, wallet_id)
+            if on_done:
+                on_done(share)
+
+        return Session(
+            session_id=session_id,
+            party=party,
+            node_id=self.node_id,
+            participants=sorted(set(old_quorum) | set(new_committee)),
+            transport=self.transport,
+            identity=self.identity,
+            broadcast_topic=wire.resharing_broadcast_topic(key_type, wallet_id),
+            direct_topic_fn=lambda n: wire.resharing_direct_topic(key_type, n, wallet_id),
+            on_done=persist_and_done,
+            on_error=on_error,
+        )

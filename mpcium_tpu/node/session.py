@@ -1,0 +1,251 @@
+"""Transport-bound protocol session.
+
+Binds a transport-free protocol party (protocol/*) to the messaging fabric:
+outbound round messages are wrapped in signed envelopes and routed broadcast
+vs unicast (reference session.go:97-134); inbound envelopes are verified
+(Ed25519) before reaching the party (session.go:164-205); party state is
+mutex-guarded (the reference's update mutex, session.go:79).
+
+The reference's 1-second sleep barrier (event_consumer.go:173,325,484 — a
+TODO'd hack) is replaced by a real readiness handshake: each participant
+broadcasts a signed ``hello`` for the session and buffers protocol traffic
+until every quorum member has said hello; receiving a hello from a peer we
+haven't seen triggers a re-broadcast of our own, so late subscribers
+converge without polling.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..identity.identity import IdentityStore
+from ..protocol.base import PartyBase, ProtocolError, RoundMsg
+from ..transport.api import Transport
+from ..utils import log
+from ..wire import Envelope
+
+HELLO_ROUND = "__hello__"
+
+
+class SessionError(Exception):
+    def __init__(self, message: str, culprit: Optional[str] = None):
+        super().__init__(message)
+        self.culprit = culprit
+
+
+class Session:
+    """One protocol run bound to topics.
+
+    ``broadcast_topic``: fan-out topic for this session; ``direct_topic_fn``:
+    node_id → unicast topic (reference TopicComposer, session.go:45-48).
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        party: PartyBase,
+        node_id: str,
+        participants: Sequence[str],
+        transport: Transport,
+        identity: IdentityStore,
+        broadcast_topic: str,
+        direct_topic_fn: Callable[[str], str],
+        on_done: Optional[Callable[[object], None]] = None,
+        on_error: Optional[Callable[[Exception], None]] = None,
+    ):
+        self.session_id = session_id
+        self.party = party
+        self.node_id = node_id
+        self.participants = sorted(participants)
+        self.transport = transport
+        self.identity = identity
+        self.broadcast_topic = broadcast_topic
+        self.direct_topic_fn = direct_topic_fn
+        self.on_done = on_done
+        self.on_error = on_error
+        self._lock = threading.RLock()
+        self._subs: List = []
+        self._started = False
+        self._failed = False
+        self._hellos = {node_id}
+        self._buffer: List[RoundMsg] = []
+        self.created_at = time.monotonic()
+        self.last_activity = self.created_at
+        self._done_evt = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def listen(self) -> None:
+        """Subscribe broadcast + own direct topic, then announce readiness
+        (replaces ListenToIncomingMessageAsync + sleep barrier)."""
+        self._subs.append(
+            self.transport.pubsub.subscribe(self.broadcast_topic, self._on_raw)
+        )
+        self._subs.append(
+            self.transport.direct.listen(
+                self.direct_topic_fn(self.node_id), self._on_raw
+            )
+        )
+        self._send_hello()
+
+    def close(self) -> None:
+        for s in self._subs:
+            try:
+                s.unsubscribe()
+            except Exception:  # noqa: BLE001
+                pass
+        self._subs.clear()
+
+    def wait(self, timeout_s: float) -> bool:
+        return self._done_evt.wait(timeout_s)
+
+    @property
+    def done(self) -> bool:
+        return self.party.done
+
+    @property
+    def result(self):
+        return self.party.result
+
+    # -- outbound -----------------------------------------------------------
+
+    def _send_hello(self) -> None:
+        env = Envelope(
+            session_id=self.session_id,
+            round=HELLO_ROUND,
+            from_id=self.node_id,
+            payload={},
+        )
+        self.identity.sign_envelope(env)
+        self.transport.pubsub.publish(self.broadcast_topic, env.encode())
+
+    def _route(self, msgs: Sequence[RoundMsg]) -> None:
+        for m in msgs:
+            env = Envelope(
+                session_id=m.session_id,
+                round=m.round,
+                from_id=m.from_id,
+                payload=m.payload,
+                to=m.to,
+                is_broadcast=m.is_broadcast,
+            )
+            self.identity.sign_envelope(env)
+            raw = env.encode()
+            if m.is_broadcast:
+                self.transport.pubsub.publish(self.broadcast_topic, raw)
+            else:
+                # acked unicast with retry (reference session.go:126,
+                # point2point.go:26-45)
+                self.transport.direct.send(self.direct_topic_fn(m.to), raw)
+
+    # -- inbound ------------------------------------------------------------
+
+    def _on_raw(self, raw: bytes) -> None:
+        try:
+            env = Envelope.decode(raw)
+        except Exception as e:  # noqa: BLE001
+            log.warn("undecodable envelope dropped", session=self.session_id,
+                     error=repr(e))
+            return
+        if env.session_id != self.session_id:
+            return
+        if env.from_id == self.node_id:
+            return  # own broadcast echo
+        if env.from_id not in self.participants:
+            log.warn("message from non-participant dropped",
+                     session=self.session_id, sender=env.from_id)
+            return
+        if not self.identity.verify_envelope(env):
+            log.warn("BAD SIGNATURE on envelope — dropped",
+                     session=self.session_id, sender=env.from_id)
+            return
+        if env.round == HELLO_ROUND:
+            self._on_hello(env.from_id)
+            return
+        msg = RoundMsg(
+            session_id=env.session_id,
+            round=env.round,
+            from_id=env.from_id,
+            payload=env.payload,
+            to=env.to,
+        )
+        with self._lock:
+            self.last_activity = time.monotonic()
+            if not self._started:
+                self._buffer.append(msg)
+                return
+        self._deliver(msg)
+
+    def _on_hello(self, from_id: str) -> None:
+        start_now = False
+        with self._lock:
+            if from_id not in self._hellos:
+                self._hellos.add(from_id)
+                # answer late joiners so they converge too
+                self._send_hello()
+            if (
+                not self._started
+                and self._hellos >= set(self.participants)
+            ):
+                self._started = True
+                start_now = True
+        if start_now:
+            self._start_party()
+
+    def _start_party(self) -> None:
+        try:
+            with self._lock:
+                out = self.party.start()
+                buffered, self._buffer = self._buffer, []
+            self._route(out)
+            for m in buffered:
+                self._deliver(m)
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _deliver(self, msg: RoundMsg) -> None:
+        try:
+            with self._lock:
+                if self._failed or self.party.done:
+                    return
+                out = self.party.receive(msg)
+                finished = self.party.done
+            self._route(out)
+            if finished:
+                self._finish()
+        except ProtocolError as e:
+            self._fail(e)
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _finish(self) -> None:
+        if self._done_evt.is_set():
+            return
+        self._done_evt.set()
+        log.info("session complete", session=self.session_id, node=self.node_id)
+        if self.on_done:
+            try:
+                self.on_done(self.party.result)
+            except Exception as e:  # noqa: BLE001
+                log.error("on_done callback failed", session=self.session_id,
+                          error=repr(e))
+
+    def _fail(self, e: Exception) -> None:
+        with self._lock:
+            if self._failed:
+                return
+            self._failed = True
+        culprit = getattr(e, "culprit", None)
+        log.error("session failed", session=self.session_id, node=self.node_id,
+                  error=str(e), culprit=culprit or "")
+        self._done_evt.set()
+        if self.on_error:
+            try:
+                self.on_error(e)
+            except Exception as cb_e:  # noqa: BLE001
+                log.error("on_error callback failed", error=repr(cb_e))
+
+    @property
+    def failed(self) -> bool:
+        return self._failed

@@ -41,9 +41,18 @@ class PeerRegistry:
     # -- lifecycle ----------------------------------------------------------
 
     def ready(self) -> None:
-        """Announce readiness (registry.go:93-107)."""
-        self.kv.put(READY_PREFIX + self.node_id, b"true")
+        """Announce readiness (registry.go:93-107). The value carries a
+        heartbeat timestamp; the watch loop refreshes it each tick and
+        watchers treat stale entries as dead — so a SIGKILLed node that
+        never ran resign() falls out of quorum instead of poisoning every
+        future session (Consul achieves this with session TTLs)."""
+        self._heartbeat()
         self._poll_once()
+
+    def _heartbeat(self) -> None:
+        self.kv.put(
+            READY_PREFIX + self.node_id, str(time.time()).encode()
+        )
 
     def resign(self) -> None:
         """De-register on shutdown (registry.go:198-207)."""
@@ -92,13 +101,30 @@ class PeerRegistry:
 
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
+            if self.kv.get(READY_PREFIX + self.node_id) is not None:
+                self._heartbeat()  # refresh own TTL while registered
             self._poll_once()
 
+    def _stale_after_s(self) -> float:
+        # a peer missing 5 heartbeat periods (min 3 s) is dead
+        return max(5 * self.poll_interval_s, 3.0)
+
     def _poll_once(self) -> None:
-        now = {
-            k[len(READY_PREFIX):]
-            for k in self.kv.keys(READY_PREFIX)
-        } & set(self.peer_ids)
+        cutoff = time.time() - self._stale_after_s()
+        now = set()
+        for k in self.kv.keys(READY_PREFIX):
+            pid = k[len(READY_PREFIX):]
+            if pid not in self.peer_ids:
+                continue
+            raw = self.kv.get(k)
+            if raw is None:
+                continue
+            try:
+                ts = float(raw)
+            except ValueError:
+                ts = 0.0  # legacy "true" value: treat as stale-capable
+            if ts >= cutoff:
+                now.add(pid)
         with self._lock:
             joined = now - self._ready_map
             left = self._ready_map - now

@@ -81,13 +81,24 @@ class LoopbackFabric:
             "queue": defaultdict(list),
         }
         self._queue_config = queue_config
-        self._seen_msg_ids: Set[Tuple[str, str]] = set()
+        # idempotency keys live for a bounded window (JetStream's duplicate
+        # window semantics): repeats within it are deduped, later legitimate
+        # re-submissions (e.g. a second reshare of the same wallet) pass,
+        # and the set cannot grow without bound
+        self._dedup_window_s = 120.0
+        self._seen_msg_ids: Dict[Tuple[str, str], float] = {}
         self._dead_letter: List[DeadLetterHandler] = []
         self._pending_queue_msgs: deque = deque()  # undelivered (no consumer yet)
         self._seq = itertools.count()
         self._closed = False
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="loopback"
+        )
+        # queue handlers may block for long periods (e.g. the signing
+        # bridge's reply wait) — they get their own pool so they cannot
+        # starve protocol pub/sub + direct delivery
+        self._qpool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="loopback-q"
         )
         self._inflight = 0
         self._idle = threading.Condition(self._lock)
@@ -97,6 +108,7 @@ class LoopbackFabric:
     def close(self) -> None:
         self._closed = True
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._qpool.shutdown(wait=False, cancel_futures=True)
 
     def drain(self, timeout_s: float = 60.0) -> None:
         """Block until no handler is in flight (tests)."""
@@ -110,7 +122,7 @@ class LoopbackFabric:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _post(self, fn: Callable[[], None]) -> None:
+    def _post(self, fn: Callable[[], None], blocking: bool = False) -> None:
         if self._closed:
             raise TransportError("fabric closed")
         with self._lock:
@@ -129,7 +141,7 @@ class LoopbackFabric:
                     if self._inflight == 0:
                         self._idle.notify_all()
 
-        self._pool.submit(run)
+        (self._qpool if blocking else self._pool).submit(run)
 
     # -- pub/sub ------------------------------------------------------------
 
@@ -188,10 +200,16 @@ class LoopbackFabric:
     def enqueue(self, topic: str, data: bytes, idempotency_key: str = "") -> None:
         if idempotency_key:
             with self._lock:
+                now = time.monotonic()
                 key = (topic.rsplit(".", 1)[0], idempotency_key)
+                self._seen_msg_ids = {
+                    k: t
+                    for k, t in self._seen_msg_ids.items()
+                    if now - t < self._dedup_window_s
+                }
                 if key in self._seen_msg_ids:
                     return  # deduped (Nats-Msg-Id semantics)
-                self._seen_msg_ids.add(key)
+                self._seen_msg_ids[key] = now
         self._deliver_queue_msg(topic, data, deliveries=0)
 
     def _deliver_queue_msg(self, topic: str, data: bytes, deliveries: int) -> None:
@@ -221,7 +239,7 @@ class LoopbackFabric:
                 else:
                     self._deliver_queue_msg(topic, data, n)
 
-        self._post(run)
+        self._post(run, blocking=True)
 
     def _flush_pending(self) -> None:
         with self._lock:

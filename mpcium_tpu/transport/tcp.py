@@ -1,0 +1,519 @@
+"""TCP message bus: the multi-process NATS equivalent.
+
+One :class:`BrokerServer` (the `nats-server` analogue from the reference's
+docker-compose) + per-process :class:`TcpTransport` clients implementing
+the same four delivery semantics as the loopback fabric:
+
+- pub/sub fan-out (with trailing-``*`` patterns)
+- acked unicast: the broker routes to one listener and relays the ack;
+  the sender retries on timeout (reference point2point.go budgets)
+- durable queues: broker-held state — pending buffering, Nats-Msg-Id
+  idempotency, per-message delivery counts, redelivery on nak/disconnect,
+  dead-letter broadcast after max_deliver
+- dead-letter events fan out to every connected client that registered
+
+Framing: newline-delimited JSON, payloads hex-encoded. This is a dev/ops
+fabric for single-digit node counts (the reference's deployment shape);
+protocol payload sizes are small (keygen/signing round messages). TLS and
+auth ride on deployment-level network isolation, as with the reference's
+dev NATS (production adds TLS config — config.prod.yaml.template).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from .api import (
+    DeadLetterHandler,
+    DirectMessaging,
+    Handler,
+    MessageQueue,
+    Permanent,
+    PubSub,
+    QueueConfig,
+    QueueHandler,
+    Subscription,
+    Transport,
+    TransportError,
+)
+from .loopback import topic_matches
+from ..utils import log
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+    sock.sendall(data)
+
+
+class _Conn:
+    """Broker-side client connection."""
+
+    def __init__(self, sock: socket.socket, broker: "BrokerServer", cid: int):
+        self.sock = sock
+        self.broker = broker
+        self.cid = cid
+        self.subs: Dict[int, Tuple[str, str]] = {}  # sid -> (kind, pattern)
+        self.wants_dead_letters = False
+        self.lock = threading.Lock()
+        self.alive = True
+
+    def send(self, obj: dict) -> bool:
+        try:
+            with self.lock:
+                _send_frame(self.sock, obj)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+
+class BrokerServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        queue_config: QueueConfig = QueueConfig(),
+    ):
+        self.queue_config = queue_config
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()
+        self._conns: Dict[int, _Conn] = {}
+        self._lock = threading.RLock()
+        self._cid = itertools.count(1)
+        self._did = itertools.count(1)
+        self._rr = itertools.count()
+        # bounded dedup window (JetStream duplicate-window semantics)
+        self._dedup_window_s = 120.0
+        self._seen_ids: Dict[Tuple[str, str], float] = {}
+        self._pending_q: deque = deque()  # (topic, data, deliveries)
+        self._inflight: Dict[int, Tuple[str, bytes, int, int]] = {}
+        # did -> (topic, data, deliveries, cid)
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="broker-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in self._conns.values():
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+
+    # -- accept/read --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock, self, next(self._cid))
+            with self._lock:
+                self._conns[conn.cid] = conn
+            threading.Thread(
+                target=self._read_loop, args=(conn,),
+                name=f"broker-read-{conn.cid}", daemon=True,
+            ).start()
+
+    def _read_loop(self, conn: _Conn) -> None:
+        buf = b""
+        try:
+            while not self._closed:
+                chunk = conn.sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line:
+                        self._handle(conn, json.loads(line))
+        except (OSError, json.JSONDecodeError):
+            pass
+        finally:
+            self._drop(conn)
+
+    def _drop(self, conn: _Conn) -> None:
+        conn.alive = False
+        with self._lock:
+            self._conns.pop(conn.cid, None)
+            # redeliver this client's unacked queue messages
+            orphaned = [
+                (did, v) for did, v in self._inflight.items() if v[3] == conn.cid
+            ]
+            for did, (topic, data, deliveries, _cid) in orphaned:
+                del self._inflight[did]
+                self._queue_dispatch(topic, data, deliveries)
+
+    # -- frame handling ------------------------------------------------------
+
+    def _handle(self, conn: _Conn, f: dict) -> None:
+        op = f.get("op")
+        if op == "sub":
+            with self._lock:
+                conn.subs[f["sid"]] = (f["kind"], f["pattern"])
+            if f["kind"] == "queue":
+                self._flush_pending()
+        elif op == "unsub":
+            with self._lock:
+                conn.subs.pop(f["sid"], None)
+        elif op == "dead_sub":
+            conn.wants_dead_letters = True
+        elif op == "pub":
+            self._fanout(f["topic"], f["data"], f.get("reply"))
+        elif op == "direct":
+            self._direct(conn, f)
+        elif op == "ack":  # receiver acked a direct message
+            self._relay_ack(f)
+        elif op == "enqueue":
+            key = f.get("key", "")
+            if key:
+                with self._lock:
+                    now = time.monotonic()
+                    self._seen_ids = {
+                        k: t
+                        for k, t in self._seen_ids.items()
+                        if now - t < self._dedup_window_s
+                    }
+                    dk = (f["topic"].rsplit(".", 1)[0], key)
+                    if dk in self._seen_ids:
+                        return
+                    self._seen_ids[dk] = now
+            self._queue_dispatch(f["topic"], f["data"], 0)
+        elif op == "qack":
+            with self._lock:
+                self._inflight.pop(f["did"], None)
+        elif op == "qnak":
+            with self._lock:
+                v = self._inflight.pop(f["did"], None)
+            if v:
+                topic, data, deliveries, _cid = v
+                if f.get("permanent"):
+                    return
+                if deliveries >= self.queue_config.max_deliver:
+                    self._dead_letter(topic, data, deliveries)
+                else:
+                    self._queue_dispatch(topic, data, deliveries)
+
+    # -- pub/sub -------------------------------------------------------------
+
+    def _fanout(self, topic: str, data_hex: str, reply: Optional[str]) -> None:
+        with self._lock:
+            targets = [
+                (c, sid)
+                for c in self._conns.values()
+                for sid, (kind, pat) in c.subs.items()
+                if kind == "pubsub" and topic_matches(pat, topic)
+            ]
+        for c, sid in targets:
+            c.send({"op": "msg", "sid": sid, "topic": topic, "data": data_hex,
+                    "reply": reply})
+
+    # -- direct --------------------------------------------------------------
+
+    def _direct(self, sender: _Conn, f: dict) -> None:
+        with self._lock:
+            targets = [
+                (c, sid)
+                for c in self._conns.values()
+                for sid, (kind, pat) in c.subs.items()
+                if kind == "direct" and topic_matches(pat, f["topic"])
+            ]
+        if not targets:
+            sender.send({"op": "dack", "rid": f["rid"], "ok": False})
+            return
+        c, sid = targets[0]
+        ok = c.send(
+            {"op": "dmsg", "sid": sid, "data": f["data"], "rid": f["rid"],
+             "from_cid": sender.cid}
+        )
+        if not ok:
+            sender.send({"op": "dack", "rid": f["rid"], "ok": False})
+
+    def _relay_ack(self, f: dict) -> None:
+        target_cid = f.get("to_cid")
+        with self._lock:
+            conn = self._conns.get(target_cid)
+        if conn:
+            conn.send({"op": "dack", "rid": f["rid"], "ok": bool(f.get("ok", True))})
+
+    # -- queues --------------------------------------------------------------
+
+    def _queue_dispatch(self, topic: str, data_hex: str, deliveries: int) -> None:
+        with self._lock:
+            targets = [
+                (c, sid)
+                for c in self._conns.values()
+                for sid, (kind, pat) in c.subs.items()
+                if kind == "queue" and topic_matches(pat, topic)
+            ]
+            if not targets:
+                self._pending_q.append((topic, data_hex, deliveries))
+                return
+            c, sid = targets[next(self._rr) % len(targets)]
+            did = next(self._did)
+            self._inflight[did] = (topic, data_hex, deliveries + 1, c.cid)
+        if not c.send(
+            {"op": "qmsg", "sid": sid, "did": did, "data": data_hex, "topic": topic}
+        ):
+            with self._lock:
+                self._inflight.pop(did, None)
+            self._queue_dispatch(topic, data_hex, deliveries)
+
+    def _flush_pending(self) -> None:
+        with self._lock:
+            pending, self._pending_q = list(self._pending_q), deque()
+        for topic, data_hex, deliveries in pending:
+            self._queue_dispatch(topic, data_hex, deliveries)
+
+    def _dead_letter(self, topic: str, data_hex: str, deliveries: int) -> None:
+        with self._lock:
+            targets = [c for c in self._conns.values() if c.wants_dead_letters]
+        for c in targets:
+            c.send({"op": "dead", "topic": topic, "data": data_hex,
+                    "deliveries": deliveries})
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class _ClientSub(Subscription):
+    def __init__(self, client: "TcpClient", sid: int):
+        self.client = client
+        self.sid = sid
+
+    def unsubscribe(self) -> None:
+        self.client._unsubscribe(self.sid)
+
+
+class TcpClient:
+    """One broker connection per process; thread-pool handler execution."""
+
+    def __init__(self, host: str, port: int, workers: int = 16):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._sid = itertools.count(1)
+        self._rid = itertools.count(1)
+        self._handlers: Dict[int, Tuple[str, object]] = {}
+        self._dack_events: Dict[int, Tuple[threading.Event, List[bool]]] = {}
+        self._dead_handlers: List[DeadLetterHandler] = []
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="tcpbus")
+        # queue handlers may block (signing bridge reply wait): own pool so
+        # they cannot starve pub/sub + direct delivery
+        self._qpool = ThreadPoolExecutor(max_workers=workers,
+                                         thread_name_prefix="tcpbus-q")
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="tcpbus-read", daemon=True
+        )
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._qpool.shutdown(wait=False, cancel_futures=True)
+
+    def _send(self, obj: dict) -> None:
+        if self._closed:
+            raise TransportError("client closed")
+        with self._wlock:
+            _send_frame(self.sock, obj)
+
+    # -- subscription registry ----------------------------------------------
+
+    def _subscribe(self, kind: str, pattern: str, handler) -> _ClientSub:
+        sid = next(self._sid)
+        self._handlers[sid] = (kind, handler)
+        self._send({"op": "sub", "kind": kind, "pattern": pattern, "sid": sid})
+        return _ClientSub(self, sid)
+
+    def _unsubscribe(self, sid: int) -> None:
+        self._handlers.pop(sid, None)
+        try:
+            self._send({"op": "unsub", "sid": sid})
+        except TransportError:
+            pass
+
+    # -- reader --------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        buf = b""
+        try:
+            while not self._closed:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line:
+                        self._dispatch(json.loads(line))
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    def _dispatch(self, f: dict) -> None:
+        op = f.get("op")
+        if op == "msg":
+            ent = self._handlers.get(f["sid"])
+            if ent:
+                _kind, handler = ent
+                data = bytes.fromhex(f["data"])
+                reply = f.get("reply")
+                if reply:
+                    data = json.dumps(
+                        {"reply": reply, "data": data.hex()}
+                    ).encode()
+                self._pool.submit(self._safe, handler, data)
+        elif op == "dmsg":
+            ent = self._handlers.get(f["sid"])
+
+            def run():
+                ok = True
+                if ent:
+                    try:
+                        ent[1](bytes.fromhex(f["data"]))
+                    except Exception:  # noqa: BLE001
+                        ok = False
+                try:
+                    self._send({"op": "ack", "rid": f["rid"],
+                                "to_cid": f["from_cid"], "ok": ok})
+                except TransportError:
+                    pass
+
+            self._pool.submit(run)
+        elif op == "dack":
+            ent = self._dack_events.get(f["rid"])
+            if ent:
+                ent[1].append(bool(f.get("ok")))
+                ent[0].set()
+        elif op == "qmsg":
+            ent = self._handlers.get(f["sid"])
+
+            def runq():
+                if ent is None:
+                    self._send({"op": "qnak", "did": f["did"]})
+                    return
+                try:
+                    ent[1](bytes.fromhex(f["data"]))
+                    self._send({"op": "qack", "did": f["did"]})
+                except Permanent:
+                    self._send({"op": "qnak", "did": f["did"], "permanent": True})
+                except Exception:  # noqa: BLE001
+                    self._send({"op": "qnak", "did": f["did"]})
+
+            self._qpool.submit(runq)
+        elif op == "dead":
+            for h in list(self._dead_handlers):
+                self._pool.submit(
+                    self._safe_dead, h, f["topic"], bytes.fromhex(f["data"]),
+                    f["deliveries"],
+                )
+
+    @staticmethod
+    def _safe(handler, data) -> None:
+        try:
+            handler(data)
+        except Exception as e:  # noqa: BLE001
+            log.error("tcp bus handler error", error=repr(e))
+
+    @staticmethod
+    def _safe_dead(handler, topic, data, deliveries) -> None:
+        try:
+            handler(topic, data, deliveries)
+        except Exception as e:  # noqa: BLE001
+            log.error("dead-letter handler error", error=repr(e))
+
+    # -- ops ------------------------------------------------------------------
+
+    def publish(self, topic: str, data: bytes, reply: Optional[str] = None) -> None:
+        self._send({"op": "pub", "topic": topic, "data": data.hex(),
+                    "reply": reply})
+
+    def direct_send(self, topic: str, data: bytes, timeout_s: float = 3.0,
+                    attempts: int = 3, retry_delay_s: float = 0.05) -> None:
+        for _ in range(attempts):
+            rid = next(self._rid)
+            evt: Tuple[threading.Event, List[bool]] = (threading.Event(), [])
+            self._dack_events[rid] = evt
+            try:
+                self._send({"op": "direct", "topic": topic, "data": data.hex(),
+                            "rid": rid})
+                if evt[0].wait(timeout_s) and evt[1] and evt[1][0]:
+                    return
+            finally:
+                self._dack_events.pop(rid, None)
+            time.sleep(retry_delay_s)
+        raise TransportError(f"direct send to {topic!r} not acked")
+
+    def enqueue(self, topic: str, data: bytes, idempotency_key: str = "") -> None:
+        self._send({"op": "enqueue", "topic": topic, "data": data.hex(),
+                    "key": idempotency_key})
+
+    def add_dead_letter_handler(self, handler: DeadLetterHandler) -> None:
+        if not self._dead_handlers:
+            self._send({"op": "dead_sub"})
+        self._dead_handlers.append(handler)
+
+
+def tcp_transport(host: str, port: int) -> Transport:
+    """Connect to a broker → a :class:`Transport` bundle."""
+    client = TcpClient(host, port)
+
+    class _PS(PubSub):
+        def publish(self, topic, data):
+            client.publish(topic, data)
+
+        def publish_with_reply(self, topic, reply_topic, data):
+            client.publish(topic, data, reply=reply_topic)
+
+        def subscribe(self, topic, handler: Handler):
+            return client._subscribe("pubsub", topic, handler)
+
+    class _DM(DirectMessaging):
+        def send(self, topic, data):
+            client.direct_send(topic, data)
+
+        def listen(self, topic, handler: Handler):
+            return client._subscribe("direct", topic, handler)
+
+    class _MQ(MessageQueue):
+        def enqueue(self, topic, data, idempotency_key=""):
+            client.enqueue(topic, data, idempotency_key)
+
+        def dequeue(self, topic_filter, handler: QueueHandler):
+            return client._subscribe("queue", topic_filter, handler)
+
+    t = Transport(
+        pubsub=_PS(),
+        direct=_DM(),
+        queues=_MQ(),
+        set_dead_letter_handler=client.add_dead_letter_handler,
+    )
+    t.client = client  # keep a handle for close()
+    return t

@@ -205,25 +205,37 @@ RESULT_ERROR = "error"
 
 @dataclass
 class KeygenSuccessEvent:
-    """reference mpc.KeygenSuccessEvent: one wallet, both curve pubkeys."""
+    """reference mpc.KeygenSuccessEvent: one wallet, both curve pubkeys.
+
+    The success shape is byte-compatible with the reference; failures add
+    result_type/error_reason (the reference publishes NOTHING on keygen
+    failure and clients wait forever — a wart not worth reproducing)."""
 
     wallet_id: str
     ecdsa_pub_key: str  # hex (SEC1 compressed; reference emits raw X||Y)
     eddsa_pub_key: str  # hex (compressed Edwards)
+    result_type: str = RESULT_SUCCESS
+    error_reason: str = ""
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "wallet_id": self.wallet_id,
             "ecdsa_pub_key": self.ecdsa_pub_key,
             "eddsa_pub_key": self.eddsa_pub_key,
         }
+        if self.result_type != RESULT_SUCCESS:
+            out["result_type"] = self.result_type
+            out["error_reason"] = self.error_reason
+        return out
 
     @classmethod
     def from_json(cls, d) -> "KeygenSuccessEvent":
         return cls(
             wallet_id=d["wallet_id"],
-            ecdsa_pub_key=d["ecdsa_pub_key"],
-            eddsa_pub_key=d["eddsa_pub_key"],
+            ecdsa_pub_key=d.get("ecdsa_pub_key", ""),
+            eddsa_pub_key=d.get("eddsa_pub_key", ""),
+            result_type=d.get("result_type", RESULT_SUCCESS),
+            error_reason=d.get("error_reason", ""),
         )
 
 
@@ -274,20 +286,27 @@ class SigningResultEvent:
 
 @dataclass
 class ResharingSuccessEvent:
-    """reference mpc.ResharingSuccessEvent (ecdsa_resharing_session.go:40-44)."""
+    """reference mpc.ResharingSuccessEvent (ecdsa_resharing_session.go:40-44),
+    plus an error shape (result_type/error_reason) for terminal failures."""
 
     wallet_id: str
     new_threshold: int
     key_type: str
     pub_key: str  # hex
+    result_type: str = RESULT_SUCCESS
+    error_reason: str = ""
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "wallet_id": self.wallet_id,
             "new_threshold": self.new_threshold,
             "key_type": self.key_type,
             "pub_key": self.pub_key,
         }
+        if self.result_type != RESULT_SUCCESS:
+            out["result_type"] = self.result_type
+            out["error_reason"] = self.error_reason
+        return out
 
     @classmethod
     def from_json(cls, d) -> "ResharingSuccessEvent":
@@ -295,7 +314,9 @@ class ResharingSuccessEvent:
             wallet_id=d["wallet_id"],
             new_threshold=int(d["new_threshold"]),
             key_type=d["key_type"],
-            pub_key=d["pub_key"],
+            pub_key=d.get("pub_key", ""),
+            result_type=d.get("result_type", RESULT_SUCCESS),
+            error_reason=d.get("error_reason", ""),
         )
 
 

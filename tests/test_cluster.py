@@ -1,0 +1,146 @@
+"""Full-cluster end-to-end: create wallet → sign (both curves) → reshare.
+
+The analogue of the reference's manual 3-node docker-compose test flow
+(SURVEY.md §4 "de-facto testing"), automated in-process.
+"""
+import hashlib
+import secrets
+
+import pytest
+
+from mpcium_tpu import wire
+from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+from mpcium_tpu.core import hostmath as hm
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = LocalCluster(
+        n_nodes=3,
+        threshold=1,
+        root_dir=str(tmp_path_factory.mktemp("cluster")),
+        preparams=load_test_preparams(),
+        # reference budget: 30 s reply wait (sign_consumer.go:16-20) — a
+        # full GG18 signing run fits inside one delivery window
+        reply_timeout_s=30.0,
+    )
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def wallet(cluster):
+    ev = cluster.create_wallet_sync("wallet-1")
+    return ev
+
+
+def test_create_wallet(wallet):
+    assert wallet.wallet_id == "wallet-1"
+    # both pubkeys valid encodings
+    secp_pub = hm.secp_decompress(bytes.fromhex(wallet.ecdsa_pub_key))
+    assert not secp_pub.is_infinity
+    hm.ed_decompress(bytes.fromhex(wallet.eddsa_pub_key))
+
+
+def test_sign_eddsa(cluster, wallet):
+    tx = b"solana transfer 1 SOL"
+    ev = cluster.sign_sync(
+        wire.SignTxMessage(
+            key_type="ed25519",
+            wallet_id="wallet-1",
+            network_internal_code="solana-devnet",
+            tx_id="tx-ed-1",
+            tx=tx,
+        )
+    )
+    assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+    sig = bytes.fromhex(ev.signature)
+    assert hm.ed25519_verify(bytes.fromhex(wallet.eddsa_pub_key), tx, sig)
+
+
+def test_sign_ecdsa(cluster, wallet):
+    digest = hashlib.sha256(b"eth transfer").digest()
+    ev = cluster.sign_sync(
+        wire.SignTxMessage(
+            key_type="secp256k1",
+            wallet_id="wallet-1",
+            network_internal_code="ethereum",
+            tx_id="tx-ec-1",
+            tx=digest,
+        )
+    )
+    assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+    pub = hm.secp_decompress(bytes.fromhex(wallet.ecdsa_pub_key))
+    assert hm.ecdsa_verify(
+        pub, int.from_bytes(digest, "big"), int(ev.r, 16), int(ev.s, 16)
+    )
+    assert ev.signature_recovery in ("00", "01", "02", "03")
+
+
+def test_duplicate_sign_is_idempotent(cluster, wallet):
+    """Same tx twice: one result (idempotent queue + dup-session check)."""
+    tx = b"dup test"
+    msg = wire.SignTxMessage(
+        key_type="ed25519", wallet_id="wallet-1",
+        network_internal_code="sol", tx_id="tx-dup", tx=tx,
+    )
+    ev = cluster.sign_sync(msg)
+    assert ev.result_type == wire.RESULT_SUCCESS
+    results = []
+    sub = cluster.client.on_sign_result(lambda e: results.append(e))
+    try:
+        cluster.client.sign_transaction(msg)  # replay
+        cluster.fabric.drain(timeout_s=60)
+        dups = [e for e in results if e.tx_id == "tx-dup"]
+        assert dups == []  # deduped at the queue (Nats-Msg-Id semantics)
+    finally:
+        sub.unsubscribe()
+
+
+def test_unknown_wallet_sign_dead_letters(cluster):
+    """Unknown wallet: retryable → redelivery exhausts → dead-letter →
+    timeout error event to the client (the reference's DLQ path, §5.3c)."""
+    ev = cluster.sign_sync(
+        wire.SignTxMessage(
+            key_type="ed25519", wallet_id="ghost-wallet",
+            network_internal_code="sol", tx_id="tx-ghost", tx=b"x",
+        ),
+        timeout_s=120,
+    )
+    assert ev.result_type == wire.RESULT_ERROR
+    assert ev.is_timeout
+
+
+def test_forged_initiator_signature_ignored(cluster):
+    from mpcium_tpu.identity.identity import InitiatorKey
+
+    rogue = InitiatorKey.generate()
+    rogue_client_msg = wire.GenerateKeyMessage(wallet_id="evil-wallet")
+    rogue_client_msg.signature = rogue.sign(rogue_client_msg.raw())
+    cluster.client.transport.pubsub.publish(
+        wire.TOPIC_GENERATE, wire.canonical_json(rogue_client_msg.to_json())
+    )
+    cluster.fabric.drain(timeout_s=60)
+    # no node created the wallet
+    for node in cluster.nodes.values():
+        assert node.keyinfo.get("ed25519", "evil-wallet") is None
+
+
+def test_reshare_eddsa_and_sign_after(cluster, wallet):
+    ev = cluster.reshare_sync("wallet-1", new_threshold=1, key_type="ed25519")
+    assert ev.pub_key == wallet.eddsa_pub_key  # key unchanged
+    # is_reshared recorded
+    info = cluster.nodes["node0"].keyinfo.get("ed25519", "wallet-1")
+    assert info.is_reshared
+    # signing still works with the reshared shares
+    tx = b"post-reshare tx"
+    sev = cluster.sign_sync(
+        wire.SignTxMessage(
+            key_type="ed25519", wallet_id="wallet-1",
+            network_internal_code="sol", tx_id="tx-after-rs", tx=tx,
+        )
+    )
+    assert sev.result_type == wire.RESULT_SUCCESS, sev.error_reason
+    assert hm.ed25519_verify(
+        bytes.fromhex(wallet.eddsa_pub_key), tx, bytes.fromhex(sev.signature)
+    )

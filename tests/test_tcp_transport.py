@@ -1,0 +1,120 @@
+"""TCP bus: same four delivery semantics as loopback, across sockets."""
+import threading
+import time
+
+import pytest
+
+from mpcium_tpu.transport.api import Permanent, QueueConfig, TransportError
+from mpcium_tpu.transport.tcp import BrokerServer, tcp_transport
+
+
+@pytest.fixture()
+def broker():
+    b = BrokerServer(port=0, queue_config=QueueConfig(max_deliver=3))
+    yield b
+    b.close()
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_pubsub_fanout(broker):
+    t1 = tcp_transport(broker.host, broker.port)
+    t2 = tcp_transport(broker.host, broker.port)
+    got = []
+    t1.pubsub.subscribe("topic:x", lambda d: got.append(("t1", d)))
+    t2.pubsub.subscribe("topic:x", lambda d: got.append(("t2", d)))
+    time.sleep(0.05)  # sub registration in flight
+    t1.pubsub.publish("topic:x", b"hello")
+    assert _wait(lambda: len(got) == 2)
+    assert sorted(got) == [("t1", b"hello"), ("t2", b"hello")]
+    t1.client.close()
+    t2.client.close()
+
+
+def test_direct_ack_and_failure(broker):
+    t1 = tcp_transport(broker.host, broker.port)
+    t2 = tcp_transport(broker.host, broker.port)
+    got = []
+    t2.direct.listen("direct:n2", lambda d: got.append(d))
+    time.sleep(0.05)
+    t1.direct.send("direct:n2", b"ping")  # blocks until acked
+    assert got == [b"ping"]
+    with pytest.raises(TransportError):
+        t1.client.direct_send("direct:nobody", b"x", timeout_s=0.05, attempts=2,
+                              retry_delay_s=0.01)
+    t1.client.close()
+    t2.client.close()
+
+
+def test_queue_semantics(broker):
+    t = tcp_transport(broker.host, broker.port)
+    dead = []
+    t.set_dead_letter_handler(lambda topic, data, n: dead.append((topic, n)))
+    attempts = []
+
+    def failing(d):
+        attempts.append(d)
+        raise RuntimeError("boom")
+
+    t.queues.dequeue("q.f.*", failing)
+    time.sleep(0.05)
+    t.queues.enqueue("q.f.1", b"m", idempotency_key="k1")
+    t.queues.enqueue("q.f.1", b"m", idempotency_key="k1")  # dedup
+    assert _wait(lambda: len(dead) == 1, timeout=10)
+    assert len(attempts) == 3
+    # durable buffering before consumer exists
+    t.queues.enqueue("q.late.1", b"early")
+    got = []
+    t.queues.dequeue("q.late.*", lambda d: got.append(d))
+    assert _wait(lambda: got == [b"early"])
+    t.client.close()
+
+
+def test_reply_wrapper(broker):
+    t1 = tcp_transport(broker.host, broker.port)
+    t2 = tcp_transport(broker.host, broker.port)
+    import json
+
+    seen = []
+    t2.pubsub.subscribe("cmd", lambda d: seen.append(json.loads(d)))
+    time.sleep(0.05)
+    t1.pubsub.publish_with_reply("cmd", "inbox.1", b"\x01\x02")
+    assert _wait(lambda: len(seen) == 1)
+    assert seen[0]["reply"] == "inbox.1"
+    assert bytes.fromhex(seen[0]["data"]) == b"\x01\x02"
+    t1.client.close()
+    t2.client.close()
+
+
+def test_full_cluster_over_tcp(tmp_path):
+    """A 3-node MPC cluster across the TCP bus: wallet + EdDSA sign."""
+    from mpcium_tpu import wire
+    from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+    from mpcium_tpu.core import hostmath as hm
+
+    cluster = LocalCluster(
+        n_nodes=3, threshold=1, root_dir=str(tmp_path),
+        preparams=load_test_preparams(), transport="tcp",
+    )
+    try:
+        ev = cluster.create_wallet_sync("tcp-wallet")
+        tx = b"tcp tx"
+        res = cluster.sign_sync(
+            wire.SignTxMessage(
+                key_type="ed25519", wallet_id="tcp-wallet",
+                network_internal_code="sol", tx_id="tcp-tx-1", tx=tx,
+            )
+        )
+        assert res.result_type == wire.RESULT_SUCCESS, res.error_reason
+        assert hm.ed25519_verify(
+            bytes.fromhex(ev.eddsa_pub_key), tx, bytes.fromhex(res.signature)
+        )
+    finally:
+        cluster.close()
