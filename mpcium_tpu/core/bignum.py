@@ -453,14 +453,20 @@ class BarrettCtx:
 
     def powmod_fixed_base(self, base: int, ebits: jnp.ndarray) -> jnp.ndarray:
         """base^e mod m for a python-int base with per-element exponents.
-        Precomputes the base^(2^i) table host-side → one mulmod per bit
-        (half the device work of :meth:`powmod`)."""
+        Precomputes the base^(2^i) table host-side (cached per base/width)
+        → one mulmod per bit (half the device work of :meth:`powmod`)."""
         n_bits = ebits.shape[-1]
-        tbl = np.empty((n_bits, self.prof.n_limbs), dtype=np.int32)
-        b = base % self.modulus
-        for i in range(n_bits):
-            tbl[i] = to_limbs(b, self.prof)
-            b = b * b % self.modulus
+        cache = getattr(self, "_fb_tables", None)
+        if cache is None:
+            cache = self._fb_tables = {}
+        tbl = cache.get((base, n_bits))
+        if tbl is None:
+            tbl = np.empty((n_bits, self.prof.n_limbs), dtype=np.int32)
+            b = base % self.modulus
+            for i in range(n_bits):
+                tbl[i] = to_limbs(b, self.prof)
+                b = b * b % self.modulus
+            cache[(base, n_bits)] = tbl
         one = self.one_like(ebits)  # one_like only uses the batch shape
 
         def step(acc, sl):

@@ -179,6 +179,18 @@ def base_mul(bits: jnp.ndarray) -> SecpPointJ:
     return acc
 
 
+@functools.lru_cache(maxsize=None)
+def scalar_ring() -> bn.BarrettCtx:
+    """Barrett context for the group order n (the ECDSA scalar ring)."""
+    return bn.BarrettCtx(hm.SECP_N, PROF)
+
+
+def neg(a: SecpPointJ) -> SecpPointJ:
+    """Batch point negation (Y ↦ -Y)."""
+    F = secp256k1_field()
+    return SecpPointJ(a.X, F.neg(a.Y), a.Z)
+
+
 def equal(a: SecpPointJ, b: SecpPointJ) -> jnp.ndarray:
     """Batch equality: cross-multiplied, Z-invariant, identity-aware."""
     F = secp256k1_field()

@@ -1,0 +1,809 @@
+"""Batched GG18 threshold-ECDSA signing: the secp256k1 execution engine.
+
+The north-star path (SURVEY.md §6: batched 2-of-3 secp256k1 signing): B
+concurrent sessions' round compute coalesced into fixed-shape device
+dispatches per party. The protocol is mathematically identical to
+``protocol.ecdsa.signing`` (GG18: MtA with range proofs, phase-5
+commit–reveal) — re-expressed over limb tensors:
+
+- curve ops ride :mod:`core.secp256k1_jax` (12-bit limb family);
+- Paillier / ring-Pedersen modexps ride :mod:`core.bignum` Barrett contexts
+  in the 11-bit limb family (block-structured wide muls);
+- hashing (commitments, Fiat–Shamir challenges) stays host-side over
+  fixed-width byte serializations pulled from device.
+
+Transcript note: the batched fabric hashes fixed-width byte encodings (not
+the per-session host protocol's length-prefixed ints) — the two paths are
+separate wire universes; parity with the reference is at the result level
+(signatures verify under the same pubkeys).
+
+Randomness policy: a value mod M is sampled as CSPRNG bits of
+``bits(M) - 8`` (for masks, where slight undersampling only strengthens the
+bound) or reduced mod M on device; Paillier randomizers skip the
+gcd(r, N) = 1 rejection (a non-unit hit implies factoring N).
+
+Test note: proof-equation algebra holds for any key size, so unit tests run
+512-bit keys with shrunk exponent domains (the ``bits`` knobs below); the
+full-size path is exercised by bench.py on real hardware.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import secrets
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import bignum as bn
+from ..core import hostmath as hm
+from ..core import secp256k1_jax as sp
+from ..core.bignum import P256
+from ..core.paillier import PaillierBatch, PreParams
+from ..protocol.base import KeygenShare, party_xs
+
+Q = hm.SECP_N
+SCALAR_BITS = 256
+
+
+@dataclass(frozen=True)
+class Domains:
+    """Exponent-domain bit sizes (GG18 appendix A). Shrunk in unit tests."""
+
+    scalar: int = 256       # curve scalars (a, b, e)
+    alpha: int = 760        # < q³
+    beta_prime: int = 1272  # < q⁵
+    gamma_bob: int = 1784   # < q⁷
+    rho_extra: int = 248    # ρ < q·NTilde  → scalar-8 + nt bits
+    s1_bound: int = 768     # q³ bound checked by verifiers
+
+    def q3(self) -> int:
+        return Q**3
+
+
+def _prof11(bits: int) -> bn.LimbProfile:
+    return bn.LimbProfile(bits=11, n_limbs=max(2, -(-bits // 11)))
+
+
+def rand_bits(batch: int, bits: int, rng=secrets) -> np.ndarray:
+    """(B, ceil(bits/8)) CSPRNG bytes encoding a uniform `bits`-bit int."""
+    nbytes = -(-bits // 8)
+    raw = np.frombuffer(rng.token_bytes(batch * nbytes), dtype=np.uint8)
+    out = raw.reshape(batch, nbytes).copy()
+    extra = 8 * nbytes - bits
+    if extra:
+        out[:, -1] &= (1 << (8 - extra)) - 1
+    return out
+
+
+def hash_rows(tag: bytes, *parts) -> np.ndarray:
+    """Per-session SHA-256 over concatenated fixed-width rows → (B, 32)."""
+    parts = [np.asarray(p) for p in parts]
+    B = parts[0].shape[0]
+    out = np.empty((B, 32), dtype=np.uint8)
+    for i in range(B):
+        h = hashlib.sha256(b"mpcium-tpu/gg18-batch/" + tag)
+        for p in parts:
+            h.update(p[i].tobytes())
+        out[i] = np.frombuffer(h.digest(), dtype=np.uint8)
+    return out
+
+
+def _int_mul_add(e, m, add, prof) -> jnp.ndarray:
+    """e·m + add over plain integers (no modulus), normalized to the width
+    of `prof`."""
+    prod = bn.mul_wide(e, m, prof)
+    width = prof.n_limbs
+    return bn.carry(
+        bn.take_limbs(prod, 0, width) + bn.take_limbs(add, 0, width), prof
+    )
+
+
+def _bits_of(x: jnp.ndarray, prof: bn.LimbProfile, n_bits: int) -> jnp.ndarray:
+    return bn.limbs_to_bits(x, prof, n_bits)
+
+
+# ---------------------------------------------------------------------------
+# per-party static contexts
+# ---------------------------------------------------------------------------
+
+
+class PartyCtx:
+    """One signer's static crypto material + device contexts."""
+
+    def __init__(self, pid: str, pre: PreParams):
+        self.pid = pid
+        self.pre = pre
+        self.pb = PaillierBatch(pre.paillier.public)
+        self.N = pre.paillier.N
+        self.NTilde = pre.NTilde
+        self.prof_nt = _prof11(self.NTilde.bit_length())
+        self.ctx_nt = bn.BarrettCtx(self.NTilde, self.prof_nt)
+        self.h1 = pre.h1
+        self.h2 = pre.h2
+        self.nt_bytes = -(-self.NTilde.bit_length() // 8)
+        self.n2_bytes = -(-(2 * self.N.bit_length()) // 8)
+        self.n_bytes = -(-self.N.bit_length() // 8)
+
+    def commit_ring(self, m_bits: jnp.ndarray, r_bits: jnp.ndarray) -> jnp.ndarray:
+        """h1^m · h2^r mod NTilde — two fixed-base table modexps."""
+        a = self.ctx_nt.powmod_fixed_base(self.h1, m_bits)
+        b = self.ctx_nt.powmod_fixed_base(self.h2, r_bits)
+        return self.ctx_nt.mulmod(a, b)
+
+
+def _enc_deterministic(pb: PaillierBatch, m_limbs) -> jnp.ndarray:
+    """(1 + m·N) mod N² for m < N — the deterministic Paillier leg."""
+    N_l = jnp.broadcast_to(
+        jnp.asarray(pb.N_limbs), m_limbs.shape[:-1] + (pb.prof_n.n_limbs,)
+    )
+    mN = bn.mul_wide(m_limbs, N_l, pb.prof_n2)
+    out = bn.take_limbs(mN, 0, pb.prof_n2.n_limbs).at[..., 0].add(1)
+    return bn.carry(out, pb.prof_n2)
+
+
+# ---------------------------------------------------------------------------
+# batched MtA with range proofs (one ordered direction Alice → Bob → Alice)
+# ---------------------------------------------------------------------------
+
+
+class MtaBatch:
+    """Batched MtA + proofs for the ordered pair (alice, bob).
+
+    The flow mirrors protocol.ecdsa.{mta,zk} exactly; the caller drives the
+    host Fiat–Shamir points between device steps. State dicts hold limb
+    tensors; every function is shape-stable and jit-compiled on first use.
+    """
+
+    def __init__(self, alice: PartyCtx, bob: PartyCtx, dom: Domains = Domains()):
+        self.alice = alice
+        self.bob = bob
+        self.dom = dom
+        d = dom
+        self.p_e = _prof11(d.scalar)
+        self.p_alpha = _prof11(d.alpha)
+        self.p_s1 = _prof11(d.scalar + d.alpha + 11)
+        nt_bits = bob.NTilde.bit_length()
+        nt_bits_a = alice.NTilde.bit_length()
+        self.p_rho = _prof11(d.scalar + max(nt_bits, nt_bits_a) + d.rho_extra)
+        self.p_s2 = _prof11(d.scalar + self.p_rho.n_limbs * 11 + 11)
+        self.p_bp = _prof11(d.beta_prime)
+        self.p_gb = _prof11(d.gamma_bob)
+        self.p_t1 = _prof11(d.scalar + d.gamma_bob + 11)
+
+    # -- randomness bundles (host) ------------------------------------------
+
+    def _unit_mod_NA(self, B: int, rng) -> jnp.ndarray:
+        """Paillier randomizer mod N_A: (bits(N)+64)-bit sample reduced on
+        device (bias 2^-64; unit whp)."""
+        A = self.alice
+        nb = A.N.bit_length()
+        return A.pb.ctx_N.reduce(
+            bn.bytes_to_limbs_le(
+                jnp.asarray(rand_bits(B, nb + 64, rng)),
+                A.pb.prof_n, 2 * A.pb.prof_n.n_limbs,
+            )
+        )
+
+    @staticmethod
+    def _dom_bits(B, bits, prof, rng):
+        return bn.bytes_to_limbs_le(
+            jnp.asarray(rand_bits(B, bits, rng)), prof, prof.n_limbs
+        )
+
+    def alice_randoms(self, B: int, rng=secrets) -> Dict[str, jnp.ndarray]:
+        d = self.dom
+        nt_b = self.bob.NTilde.bit_length()
+        return {
+            "r": self._unit_mod_NA(B, rng),
+            "alpha": self._dom_bits(B, d.alpha - 8, self.p_alpha, rng),
+            "rho": self._dom_bits(B, d.scalar + nt_b - 8, self.p_rho, rng),
+            "gamma": self._dom_bits(B, d.alpha + nt_b - 8, self.p_s2, rng),
+            "beta_r": self._unit_mod_NA(B, rng),
+        }
+
+    def bob_randoms(self, B: int, rng=secrets) -> Dict[str, jnp.ndarray]:
+        d = self.dom
+        nt_a = self.alice.NTilde.bit_length()
+        return {
+            "beta_prime": self._dom_bits(B, d.beta_prime - 8, self.p_bp, rng),
+            "r": self._unit_mod_NA(B, rng),
+            "alpha": self._dom_bits(B, d.alpha - 8, self.p_alpha, rng),
+            "rho": self._dom_bits(B, d.scalar + nt_a - 8, self.p_rho, rng),
+            "rho_p": self._dom_bits(B, d.alpha + nt_a - 8, self.p_s2, rng),
+            "sigma": self._dom_bits(B, d.scalar + nt_a - 8, self.p_rho, rng),
+            "tau": self._dom_bits(B, d.alpha + nt_a - 8, self.p_s2, rng),
+            "beta_r": self._unit_mod_NA(B, rng),
+            "gamma": self._dom_bits(B, d.gamma_bob - 8, self.p_gb, rng),
+        }
+
+    # -- Alice: encrypt + range proof ---------------------------------------
+
+    def alice_init(self, m_limbs, R: Dict[str, jnp.ndarray]):
+        """m: plaintext (< q) as Alice-N plaintext limbs. Returns the
+        pre-challenge transcript {c_a, z, u, w}."""
+        A, Bo = self.alice, self.bob
+        c_a = A.pb.encrypt(m_limbs, R["r"])
+        z = Bo.commit_ring(
+            _bits_of(m_limbs, A.pb.prof_n, self.dom.scalar),
+            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 11),
+        )
+        u = A.pb.encrypt(
+            bn.take_limbs(R["alpha"], 0, A.pb.prof_n.n_limbs), R["beta_r"]
+        )
+        w = Bo.commit_ring(
+            _bits_of(R["alpha"], self.p_alpha, self.dom.alpha),
+            _bits_of(R["gamma"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        return {"c_a": c_a, "z": z, "u": u, "w": w}
+
+    def alice_challenge(self, T) -> np.ndarray:
+        """Fiat–Shamir e ← H(transcript) (host)."""
+        A, Bo = self.alice, self.bob
+        return hash_rows(
+            b"alice",
+            bn.limbs_to_bytes_le(T["c_a"], A.pb.prof_n2, A.n2_bytes),
+            bn.limbs_to_bytes_le(T["z"], Bo.prof_nt, Bo.nt_bytes),
+            bn.limbs_to_bytes_le(T["u"], A.pb.prof_n2, A.n2_bytes),
+            bn.limbs_to_bytes_le(T["w"], Bo.prof_nt, Bo.nt_bytes),
+        )
+
+    def e_limbs(self, e32: np.ndarray) -> jnp.ndarray:
+        return bn.bytes_to_limbs_le(jnp.asarray(e32), self.p_e, self.p_e.n_limbs)
+
+    def alice_finish(self, e, m_limbs, R):
+        """Challenge responses: s = r^e·β mod N_A; s1 = e·m + α;
+        s2 = e·ρ + γ."""
+        A = self.alice
+        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
+        s = A.pb.ctx_N.mulmod(A.pb.ctx_N.powmod(R["r"], e_bits), R["beta_r"])
+        m_e = bn.take_limbs(m_limbs, 0, self.p_e.n_limbs)
+        s1 = _int_mul_add(
+            e, m_e, bn.take_limbs(R["alpha"], 0, self.p_s1.n_limbs), self.p_s1
+        )
+        s2 = _int_mul_add(
+            e, R["rho"], bn.take_limbs(R["gamma"], 0, self.p_s2.n_limbs), self.p_s2
+        )
+        return {"s": s, "s1": s1, "s2": s2}
+
+    def bob_check_alice(self, T, P, e) -> jnp.ndarray:
+        """Batched Alice-proof verification → (B,) bool."""
+        A, Bo = self.alice, self.bob
+        q3 = jnp.broadcast_to(
+            jnp.asarray(bn.to_limbs(self.dom.q3(), self.p_s1)), P["s1"].shape
+        )
+        ok = bn.compare(P["s1"], q3) <= 0
+        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
+        n2 = A.pb.ctx_N2
+        s1_modN = A.pb.ctx_N.reduce(
+            bn.take_limbs(P["s1"], 0, 2 * A.pb.prof_n.n_limbs)
+        )
+        lhs = n2.mulmod(
+            _enc_deterministic(A.pb, s1_modN),
+            n2.powmod_const(
+                bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N
+            ),
+        )
+        rhs = n2.mulmod(T["u"], n2.powmod(T["c_a"], e_bits))
+        ok = ok & jnp.all(lhs == rhs, axis=-1)
+        lhs2 = Bo.commit_ring(
+            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11),
+            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        rhs2 = Bo.ctx_nt.mulmod(T["w"], Bo.ctx_nt.powmod(T["z"], e_bits))
+        return ok & jnp.all(lhs2 == rhs2, axis=-1)
+
+    # -- Bob: homomorphic response + proof ----------------------------------
+
+    def bob_respond(self, c_a, b_limbs, R, with_check: bool):
+        """c_b = c_a^b · Enc_A(β′); pre-challenge proof transcript.
+        ``b_limbs``: Bob's secret (< q) in the 11-bit e-profile.
+        with_check adds U = α·G for the curve binding (computed by caller
+        in the 12-bit curve family)."""
+        A = self.alice
+        b_bits = _bits_of(b_limbs, self.p_e, self.dom.scalar)
+        c_b = A.pb.ctx_N2.mulmod(
+            A.pb.ctx_N2.powmod(c_a, b_bits),
+            A.pb.encrypt(
+                bn.take_limbs(R["beta_prime"], 0, A.pb.prof_n.n_limbs), R["r"]
+            ),
+        )
+        z = A.commit_ring(
+            _bits_of(b_limbs, self.p_e, self.dom.scalar),
+            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 11),
+        )
+        z_p = A.commit_ring(
+            _bits_of(R["alpha"], self.p_alpha, self.dom.alpha),
+            _bits_of(R["rho_p"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        t = A.commit_ring(
+            _bits_of(R["beta_prime"], self.p_bp, self.dom.beta_prime),
+            _bits_of(R["sigma"], self.p_rho, self.p_rho.n_limbs * 11),
+        )
+        v = A.pb.ctx_N2.mulmod(
+            A.pb.ctx_N2.powmod(c_a, _bits_of(R["alpha"], self.p_alpha, self.dom.alpha)),
+            A.pb.encrypt(
+                bn.take_limbs(R["gamma"], 0, A.pb.prof_n.n_limbs), R["beta_r"]
+            ),
+        )
+        w = A.commit_ring(
+            _bits_of(R["gamma"], self.p_gb, self.dom.gamma_bob),
+            _bits_of(R["tau"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        return {"c_b": c_b, "z": z, "z_p": z_p, "t": t, "v": v, "w": w}
+
+    def bob_challenge(self, c_a, T, extra_rows: Sequence[np.ndarray] = ()) -> np.ndarray:
+        A = self.alice
+        rows = [
+            bn.limbs_to_bytes_le(c_a, A.pb.prof_n2, A.n2_bytes),
+            bn.limbs_to_bytes_le(T["c_b"], A.pb.prof_n2, A.n2_bytes),
+            bn.limbs_to_bytes_le(T["z"], A.prof_nt, A.nt_bytes),
+            bn.limbs_to_bytes_le(T["z_p"], A.prof_nt, A.nt_bytes),
+            bn.limbs_to_bytes_le(T["t"], A.prof_nt, A.nt_bytes),
+            bn.limbs_to_bytes_le(T["v"], A.pb.prof_n2, A.n2_bytes),
+            bn.limbs_to_bytes_le(T["w"], A.prof_nt, A.nt_bytes),
+        ]
+        rows.extend(extra_rows)
+        return hash_rows(b"bob", *rows)
+
+    def bob_finish(self, e, b_limbs, R):
+        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
+        A = self.alice
+        s = A.pb.ctx_N.mulmod(A.pb.ctx_N.powmod(R["r"], e_bits), R["beta_r"])
+        s1 = _int_mul_add(
+            e, bn.take_limbs(b_limbs, 0, self.p_e.n_limbs),
+            bn.take_limbs(R["alpha"], 0, self.p_s1.n_limbs), self.p_s1,
+        )
+        s2 = _int_mul_add(
+            e, R["rho"], bn.take_limbs(R["rho_p"], 0, self.p_s2.n_limbs), self.p_s2
+        )
+        t1 = _int_mul_add(
+            e, bn.take_limbs(R["beta_prime"], 0, self.p_t1.n_limbs),
+            bn.take_limbs(R["gamma"], 0, self.p_t1.n_limbs), self.p_t1,
+        )
+        t2 = _int_mul_add(
+            e, R["sigma"], bn.take_limbs(R["tau"], 0, self.p_s2.n_limbs), self.p_s2
+        )
+        return {"s": s, "s1": s1, "s2": s2, "t1": t1, "t2": t2}
+
+    def alice_check_bob(self, c_a, T, P, e) -> jnp.ndarray:
+        """Batched Bob-proof verification (ciphertext + ring legs; the
+        with-check curve leg is checked by the caller)."""
+        A = self.alice
+        q3 = jnp.broadcast_to(
+            jnp.asarray(bn.to_limbs(self.dom.q3(), self.p_s1)), P["s1"].shape
+        )
+        ok = bn.compare(P["s1"], q3) <= 0
+        # q⁷ bound; in shrunk test domains the profile capacity caps it
+        # (honest t1 always fits the profile by construction)
+        t1_cap = (1 << (self.p_t1.bits * self.p_t1.n_limbs)) - 1
+        q7 = jnp.broadcast_to(
+            jnp.asarray(bn.to_limbs(min(Q**7, t1_cap), self.p_t1)),
+            P["t1"].shape,
+        )
+        ok = ok & (bn.compare(P["t1"], q7) <= 0)
+        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
+        lhs = A.commit_ring(
+            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11),
+            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        rhs = A.ctx_nt.mulmod(T["z_p"], A.ctx_nt.powmod(T["z"], e_bits))
+        ok = ok & jnp.all(lhs == rhs, axis=-1)
+        lhs = A.commit_ring(
+            _bits_of(P["t1"], self.p_t1, self.p_t1.n_limbs * 11),
+            _bits_of(P["t2"], self.p_s2, self.p_s2.n_limbs * 11),
+        )
+        rhs = A.ctx_nt.mulmod(T["w"], A.ctx_nt.powmod(T["t"], e_bits))
+        ok = ok & jnp.all(lhs == rhs, axis=-1)
+        n2 = A.pb.ctx_N2
+        t1_modN = A.pb.ctx_N.reduce(
+            bn.take_limbs(P["t1"], 0, 2 * A.pb.prof_n.n_limbs)
+        )
+        lhs = n2.mulmod(
+            n2.mulmod(
+                n2.powmod(c_a, _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11)),
+                _enc_deterministic(A.pb, t1_modN),
+            ),
+            n2.powmod_const(bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N),
+        )
+        rhs = n2.mulmod(T["v"], n2.powmod(T["c_b"], e_bits))
+        return ok & jnp.all(lhs == rhs, axis=-1)
+
+    def alice_decrypt_share(self, c_b) -> jnp.ndarray:
+        """Dec_A(c_b) mod q → curve-scalar limbs (12-bit family)."""
+        A = self.alice
+        plain = A.pb.decrypt(A.pre.paillier, c_b)  # (B, n) mod N
+        return _mod_q_from_limbs(plain, A.pb.prof_n)
+
+
+# ---------------------------------------------------------------------------
+# curve-side jitted helpers (12-bit family)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _scalar_from_wide_bytes(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, 40) uniform bytes → canonical scalar mod q (bias 2^-64)."""
+    ring = sp.scalar_ring()
+    return ring.reduce(bn.bytes_to_limbs_le(b, P256, 30))
+
+
+@jax.jit
+def _base_mul_compressed(k_limbs: jnp.ndarray):
+    pt = sp.base_mul(bn.limbs_to_bits(k_limbs, P256, SCALAR_BITS))
+    return pt, sp.compress(pt)
+
+
+def _scalar_to_plain(pb: PaillierBatch, k_limbs: jnp.ndarray) -> jnp.ndarray:
+    """curve scalar (12-bit limbs) → Paillier plaintext limbs (11-bit)."""
+    b = bn.limbs_to_bytes_le(k_limbs, P256, 32)
+    return bn.bytes_to_limbs_le(b, pb.prof_n, pb.prof_n.n_limbs)
+
+
+def _scalar_to_prof(k_limbs: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
+    b = bn.limbs_to_bytes_le(k_limbs, P256, 32)
+    return bn.bytes_to_limbs_le(b, prof, prof.n_limbs)
+
+
+def _mod_q_from_limbs(x: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
+    """Reduce an arbitrary-width non-negative value mod q → 12-bit curve
+    limbs, via chunked folding: v = Σ chunk_i · (2^(176·i)) mod q."""
+    ring = sp.scalar_ring()
+    n_bytes = -(-prof.n_limbs * prof.bits // 8)
+    b = bn.limbs_to_bytes_le(x, prof, n_bytes)
+    chunk_bytes = 22  # 176 bits per chunk < 2^253
+    n_chunks = -(-n_bytes // chunk_bytes)
+    pad = n_chunks * chunk_bytes - n_bytes
+    if pad:
+        b = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, pad)])
+    chunks = b.reshape(b.shape[:-1] + (n_chunks, chunk_bytes))
+    acc = ring.const(0, x.shape[:-1])
+    shift = pow(2, chunk_bytes * 8, Q)
+    shift_l = ring.const(shift, x.shape[:-1])
+    for i in range(n_chunks - 1, -1, -1):
+        c = bn.bytes_to_limbs_le(chunks[..., i, :], P256, P256.n_limbs)
+        acc = ring.addmod(ring.mulmod(acc, shift_l), ring.reduce(c))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# two-party batched co-signing fabric (bench / loopback deployments)
+# ---------------------------------------------------------------------------
+
+
+class GG18BatchCoSigners:
+    """Runs B concurrent 2-of-n GG18 signing sessions with both signers'
+    round compute batched on device (the in-process measurement fabric —
+    the distributed node runs the same kernels per party).
+
+    ``party_shares[i]`` are signer i's per-wallet shares (same wallet order
+    across parties, one quorum topology per batch — like
+    eddsa_batch.BatchedCoSigners). Quorum size is fixed at 2 (the
+    reference's default 2-of-3 deployment); wider quorums add directions
+    pairwise.
+    """
+
+    def __init__(
+        self,
+        party_ids: Sequence[str],
+        party_shares: Sequence[Sequence[KeygenShare]],
+        preparams: Dict[str, PreParams],
+        dom: Domains = Domains(),
+        rng=secrets,
+    ):
+        assert len(party_ids) == 2, "fabric currently models the 2-signer quorum"
+        self.ids = list(party_ids)
+        self.B = len(party_shares[0])
+        self.dom = dom
+        self.rng = rng
+        self.ring = sp.scalar_ring()
+
+        first = party_shares[0][0]
+        universe_xs = party_xs(first.participants)
+        quorum_xs = [universe_xs[p] for p in party_ids]
+        self.ctx = [PartyCtx(pid, preparams[pid]) for pid in party_ids]
+        # both MtA directions
+        self.mta = {
+            (0, 1): MtaBatch(self.ctx[0], self.ctx[1], dom),
+            (1, 0): MtaBatch(self.ctx[1], self.ctx[0], dom),
+        }
+        # additive shares w_i = λ_i·x_i mod q (λ shared across the batch)
+        self.w = []
+        self.W_pts = []
+        for i, (pid, shares) in enumerate(zip(party_ids, party_shares)):
+            lam = hm.lagrange_coeff(quorum_xs, universe_xs[pid], Q)
+            w_ints = [lam * s.share % Q for s in shares]
+            w_limbs = jnp.asarray(bn.batch_to_limbs(w_ints, P256))
+            self.w.append(w_limbs)
+            for s in shares:
+                if s.key_type != "secp256k1":
+                    raise ValueError("wrong key type")
+                if s.self_x != universe_xs[pid]:
+                    raise ValueError("party_shares misaligned with party_ids")
+            W, _ = _base_mul_compressed(w_limbs)
+            self.W_pts.append(W)
+        # wallet public keys (host decompress once at setup)
+        pubs = [hm.secp_decompress(s.public_key) for s in party_shares[0]]
+        self.Y = sp.from_host(pubs)
+
+    # -- small helpers -------------------------------------------------------
+
+    def _rand_scalar(self) -> jnp.ndarray:
+        return _scalar_from_wide_bytes(jnp.asarray(rand_bits(self.B, 320, self.rng)))
+
+    def _commit(self, tag: bytes, *rows) -> Tuple[np.ndarray, np.ndarray]:
+        blind = rand_bits(self.B, 256, self.rng)
+        return hash_rows(tag, blind, *rows), blind
+
+    # -- the protocol --------------------------------------------------------
+
+    def sign(self, digests: np.ndarray) -> Dict[str, np.ndarray]:
+        """``digests``: (B, 32) big-endian digests. Returns dict with
+        r, s (B, 32 BE bytes), recovery (B,), ok mask (B,)."""
+        B = self.B
+        ring = self.ring
+        # m = digest mod q  (big-endian → little for limb decode)
+        m = ring.reduce(
+            bn.bytes_to_limbs_le(jnp.asarray(digests[:, ::-1].copy()), P256, 22)
+        )
+        m_bits = bn.limbs_to_bits(m, P256, SCALAR_BITS)
+
+        # ---- round 1: k, γ, Γ commitments + MtA inits ----------------------
+        k = [self._rand_scalar() for _ in range(2)]
+        gamma = [self._rand_scalar() for _ in range(2)]
+        Gamma, Gamma_comp, g_commit, g_blind = [], [], [], []
+        for i in range(2):
+            pt, comp = _base_mul_compressed(gamma[i])
+            Gamma.append(pt)
+            Gamma_comp.append(np.asarray(comp))
+            c, bl = self._commit(b"gamma", Gamma_comp[i])
+            g_commit.append(c)
+            g_blind.append(bl)
+
+        mta_state = {}
+        for (a, b), mta in self.mta.items():
+            Ra = mta.alice_randoms(B, self.rng)
+            k_plain = _scalar_to_plain(self.ctx[a].pb, k[a])
+            T = mta.alice_init(k_plain, Ra)
+            e = mta.e_limbs(mta.alice_challenge(T))
+            P = mta.alice_finish(e, k_plain, Ra)
+            mta_state[(a, b)] = {
+                "Ra": Ra, "T": T, "e": e, "P": P, "k_plain": k_plain,
+            }
+
+        ok = jnp.ones((B,), bool)
+
+        # ---- round 2: Bob verifies + responds (γ and w) --------------------
+        for (a, b), mta in self.mta.items():
+            st = mta_state[(a, b)]
+            ok = ok & mta.bob_check_alice(st["T"], st["P"], st["e"])
+            for name, secret in (("gamma", gamma[b]), ("w", self.w[b])):
+                Rb = mta.bob_randoms(B, self.rng)
+                b_e = _scalar_to_prof(secret, mta.p_e)
+                Tb = mta.bob_respond(st["T"]["c_a"], b_e, Rb,
+                                     with_check=(name == "w"))
+                extra = ()
+                U_pt = None
+                if name == "w":
+                    alpha_q = _mod_q_from_limbs(Rb["alpha"], mta.p_alpha)
+                    U_pt, U_comp = _base_mul_compressed(alpha_q)
+                    X_comp = sp.compress(self.W_pts[b])
+                    extra = (np.asarray(U_comp), np.asarray(X_comp))
+                e_b = mta.e_limbs(mta.bob_challenge(st["T"]["c_a"], Tb, extra))
+                Pb = mta.bob_finish(e_b, b_e, Rb)
+                st[name] = {"Rb": Rb, "Tb": Tb, "e": e_b, "Pb": Pb, "U": U_pt}
+
+        # ---- round 3: Alice verifies + decrypts; δ_i, σ_i ------------------
+        alpha_shares = {}   # (a,b,name) -> alice's additive share mod q
+        beta_shares = {}    # (a,b,name) -> bob's additive share mod q
+        for (a, b), mta in self.mta.items():
+            st = mta_state[(a, b)]
+            for name in ("gamma", "w"):
+                sub = st[name]
+                ok = ok & mta.alice_check_bob(
+                    st["T"]["c_a"], sub["Tb"], sub["Pb"], sub["e"]
+                )
+                if name == "w":
+                    # with-check: s1·G ?= U + e·W_b
+                    s1_q = _mod_q_from_limbs(sub["Pb"]["s1"], mta.p_s1)
+                    lhs = sp.base_mul(bn.limbs_to_bits(s1_q, P256, SCALAR_BITS))
+                    e_q = _mod_q_from_limbs(sub["e"], mta.p_e)
+                    rhs = sp.add(
+                        sub["U"],
+                        sp.scalar_mul(
+                            bn.limbs_to_bits(e_q, P256, SCALAR_BITS),
+                            self.W_pts[b],
+                        ),
+                    )
+                    ok = ok & sp.equal(lhs, rhs)
+                alpha_shares[(a, b, name)] = mta.alice_decrypt_share(
+                    sub["Tb"]["c_b"]
+                )
+                beta_shares[(a, b, name)] = ring.negmod(
+                    _mod_q_from_limbs(sub["Rb"]["beta_prime"], mta.p_bp)
+                )
+
+        delta_i, sigma_i = [], []
+        for i in range(2):
+            j = 1 - i
+            d = ring.addmod(
+                ring.mulmod(k[i], gamma[i]),
+                ring.addmod(
+                    alpha_shares[(i, j, "gamma")], beta_shares[(j, i, "gamma")]
+                ),
+            )
+            s_ = ring.addmod(
+                ring.mulmod(k[i], self.w[i]),
+                ring.addmod(
+                    alpha_shares[(i, j, "w")], beta_shares[(j, i, "w")]
+                ),
+            )
+            delta_i.append(d)
+            sigma_i.append(s_)
+
+        # ---- round 4: δ reveal, Γ decommit + PoK, R ------------------------
+        for i in range(2):
+            again = hash_rows(b"gamma", g_blind[i], Gamma_comp[i])
+            ok = ok & jnp.asarray((again == g_commit[i]).all(axis=1))
+        delta = ring.addmod(delta_i[0], delta_i[1])
+        nz = ~jnp.all(delta == 0, axis=-1)
+        ok = ok & nz
+        delta_inv = ring.powmod_const(delta, Q - 2)
+        Gamma_sum = sp.add(Gamma[0], Gamma[1])
+        R_pt = sp.scalar_mul(
+            bn.limbs_to_bits(delta_inv, P256, SCALAR_BITS), Gamma_sum
+        )
+        Rx = sp.x_coordinate(R_pt)          # canonical field limbs
+        r = ring.reduce(Rx)
+        ok = ok & ~jnp.all(r == 0, axis=-1)
+        # recovery metadata
+        F = __import__("mpcium_tpu.core.fields", fromlist=["secp256k1_field"]).secp256k1_field()
+        zi = F.inv(R_pt.Z)
+        y_aff = F.canonical(F.mul(R_pt.Y, zi))
+        n_limbs_ = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q, P256)), Rx.shape)
+        rec = (y_aff[..., 0] & 1) | jnp.where(bn.compare(Rx, n_limbs_) >= 0, 2, 0)
+
+        # Schnorr PoK of γ_i (batched prove + cross-verify)
+        for i in range(2):
+            k_pok = self._rand_scalar()
+            _, A_comp = _base_mul_compressed(k_pok)
+            e32 = hash_rows(b"schnorr", np.asarray(A_comp), Gamma_comp[i])
+            e_pok = ring.reduce(
+                bn.bytes_to_limbs_le(jnp.asarray(e32), P256, 22)
+            )
+            s_pok = ring.submod(k_pok, ring.mulmod(e_pok, gamma[i]))
+            lhs = sp.add(
+                sp.base_mul(bn.limbs_to_bits(s_pok, P256, SCALAR_BITS)),
+                sp.scalar_mul(bn.limbs_to_bits(e_pok, P256, SCALAR_BITS), Gamma[i]),
+            )
+            ok = ok & jnp.asarray(
+                (np.asarray(sp.compress(lhs)) == np.asarray(A_comp)).all(axis=1)
+            )
+
+        # ---- phase 5 -------------------------------------------------------
+        s_i, l_i, rho5, V_i, A_i = [], [], [], [], []
+        V_comp, A_comp5, va_commit, va_blind = [], [], [], []
+        for i in range(2):
+            si = ring.addmod(ring.mulmod(m, k[i]), ring.mulmod(r, sigma_i[i]))
+            li = self._rand_scalar()
+            ri = self._rand_scalar()
+            Vi = sp.add(
+                sp.scalar_mul(bn.limbs_to_bits(si, P256, SCALAR_BITS), R_pt),
+                sp.base_mul(bn.limbs_to_bits(li, P256, SCALAR_BITS)),
+            )
+            Ai, Ai_comp = _base_mul_compressed(ri)
+            s_i.append(si); l_i.append(li); rho5.append(ri)
+            V_i.append(Vi); A_i.append(Ai)
+            vc = np.asarray(sp.compress(Vi))
+            V_comp.append(vc); A_comp5.append(np.asarray(Ai_comp))
+            c, bl = self._commit(b"VA", vc, A_comp5[i])
+            va_commit.append(c); va_blind.append(bl)
+
+        # decommit + PedersenPoK of (s_i, l_i) in V_i = s_i·R + l_i·G
+        for i in range(2):
+            again = hash_rows(b"VA", va_blind[i], V_comp[i], A_comp5[i])
+            ok = ok & jnp.asarray((again == va_commit[i]).all(axis=1))
+            ka, kb = self._rand_scalar(), self._rand_scalar()
+            Apok = sp.add(
+                sp.scalar_mul(bn.limbs_to_bits(ka, P256, SCALAR_BITS), R_pt),
+                sp.base_mul(bn.limbs_to_bits(kb, P256, SCALAR_BITS)),
+            )
+            Apok_comp = np.asarray(sp.compress(Apok))
+            e32 = hash_rows(b"pedersen", Apok_comp, V_comp[i], A_comp5[i])
+            e5 = ring.reduce(bn.bytes_to_limbs_le(jnp.asarray(e32), P256, 22))
+            sa = ring.submod(ka, ring.mulmod(e5, s_i[i]))
+            sb = ring.submod(kb, ring.mulmod(e5, l_i[i]))
+            lhs = sp.add(
+                sp.add(
+                    sp.scalar_mul(bn.limbs_to_bits(sa, P256, SCALAR_BITS), R_pt),
+                    sp.base_mul(bn.limbs_to_bits(sb, P256, SCALAR_BITS)),
+                ),
+                sp.scalar_mul(bn.limbs_to_bits(e5, P256, SCALAR_BITS), V_i[i]),
+            )
+            ok = ok & jnp.asarray(
+                (np.asarray(sp.compress(lhs)) == Apok_comp).all(axis=1)
+            )
+
+        # V = ΣV_i - m·G - r·Y ;  U_i = ρ_i·V ;  T_i = l_i·A_sum
+        V = sp.add(
+            sp.add(V_i[0], V_i[1]),
+            sp.add(
+                sp.neg(sp.base_mul(m_bits)),
+                sp.neg(sp.scalar_mul(bn.limbs_to_bits(r, P256, SCALAR_BITS), self.Y)),
+            ),
+        )
+        A_sum = sp.add(A_i[0], A_i[1])
+        U_pts, T_pts, ut_commit, ut_blind, U_comp, T_comp = [], [], [], [], [], []
+        for i in range(2):
+            Ui = sp.scalar_mul(bn.limbs_to_bits(rho5[i], P256, SCALAR_BITS), V)
+            Ti = sp.scalar_mul(bn.limbs_to_bits(l_i[i], P256, SCALAR_BITS), A_sum)
+            U_pts.append(Ui); T_pts.append(Ti)
+            uc, tc = np.asarray(sp.compress(Ui)), np.asarray(sp.compress(Ti))
+            U_comp.append(uc); T_comp.append(tc)
+            c, bl = self._commit(b"UT", uc, tc)
+            ut_commit.append(c); ut_blind.append(bl)
+        for i in range(2):
+            again = hash_rows(b"UT", ut_blind[i], U_comp[i], T_comp[i])
+            ok = ok & jnp.asarray((again == ut_commit[i]).all(axis=1))
+        ok = ok & sp.equal(
+            sp.add(U_pts[0], U_pts[1]), sp.add(T_pts[0], T_pts[1])
+        )
+
+        # ---- reveal s_i, combine, normalize, verify ------------------------
+        s = ring.addmod(s_i[0], s_i[1])
+        ok = ok & ~jnp.all(s == 0, axis=-1)
+        half = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q // 2, P256)), s.shape)
+        high = bn.compare(s, half) > 0
+        s = jnp.where(high[..., None], ring.negmod(s), s)
+        rec = jnp.where(high, rec ^ 1, rec)
+
+        # batched ECDSA verification: x(u1·G + u2·Y) mod q == r
+        s_inv = ring.powmod_const(s, Q - 2)
+        u1 = ring.mulmod(m, s_inv)
+        u2 = ring.mulmod(r, s_inv)
+        Rv = sp.add(
+            sp.base_mul(bn.limbs_to_bits(u1, P256, SCALAR_BITS)),
+            sp.scalar_mul(bn.limbs_to_bits(u2, P256, SCALAR_BITS), self.Y),
+        )
+        ok = ok & jnp.all(ring.reduce(sp.x_coordinate(Rv)) == r, axis=-1)
+
+        return {
+            "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),
+            "s": np.asarray(bn.limbs_to_bytes_le(s, P256, 32))[:, ::-1].copy(),
+            "recovery": np.asarray(rec),
+            "ok": np.asarray(ok),
+        }
+
+
+def dealer_keygen_secp_batch(
+    n_wallets: int,
+    party_ids: Sequence[str],
+    threshold: int,
+    rng=secrets,
+) -> List[List[KeygenShare]]:
+    """Trusted-dealer batch keygen for tests/bench setup ONLY — production
+    wallets come from protocol.ecdsa.keygen. result[i] belongs to
+    party_ids[i], wallet order aligned."""
+    xs = party_xs(party_ids)
+    out: List[List[KeygenShare]] = [[] for _ in party_ids]
+    for _ in range(n_wallets):
+        secret = rng.randbelow(Q - 1) + 1
+        _, shares = hm.shamir_share(
+            secret, threshold, [xs[p] for p in party_ids], Q, rng=rng
+        )
+        pub = hm.secp_compress(hm.secp_mul(secret, hm.SECP_G))
+        for i, pid in enumerate(party_ids):
+            out[i].append(
+                KeygenShare(
+                    key_type="secp256k1",
+                    share=shares[xs[pid]],
+                    self_x=xs[pid],
+                    public_key=pub,
+                    participants=sorted(party_ids),
+                    threshold=threshold,
+                )
+            )
+    return out

@@ -1,0 +1,105 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+`batch_hash`: row-batched SHA-256/512 for the engines' host hash points
+(commitments, Fiat–Shamir challenges) — one call per batch instead of one
+Python hashlib call per session. Compiled with g++ on first import and
+cached next to the source; falls back to hashlib transparently if no
+toolchain is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "batch_hash.cpp"
+_LIB = _HERE / "libbatchhash.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            subprocess.run(
+                [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-o", str(_LIB) + ".tmp", str(_SRC), "-lpthread",
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(str(_LIB) + ".tmp", _LIB)
+        lib = ctypes.CDLL(str(_LIB))
+        for fn in (lib.batch_sha256, lib.batch_sha512):
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
+        return lib
+    except Exception:  # noqa: BLE001 — no toolchain / build failure
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _lib = _build()
+            _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def batch_sha256(prefix: bytes, rows: np.ndarray) -> np.ndarray:
+    """SHA-256(prefix ‖ row) for every row of a (B, W) uint8 array → (B, 32)."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    B, W = rows.shape
+    lib = _get_lib()
+    out = np.empty((B, 32), dtype=np.uint8)
+    if lib is not None:
+        lib.batch_sha256(
+            prefix, len(prefix),
+            rows.ctypes.data_as(ctypes.c_void_p), W, B,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    for i in range(B):
+        out[i] = np.frombuffer(
+            hashlib.sha256(prefix + rows[i].tobytes()).digest(), dtype=np.uint8
+        )
+    return out
+
+
+def batch_sha512(prefix: bytes, rows: np.ndarray) -> np.ndarray:
+    """SHA-512(prefix ‖ row) per row → (B, 64)."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    B, W = rows.shape
+    lib = _get_lib()
+    out = np.empty((B, 64), dtype=np.uint8)
+    if lib is not None:
+        lib.batch_sha512(
+            prefix, len(prefix),
+            rows.ctypes.data_as(ctypes.c_void_p), W, B,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    for i in range(B):
+        out[i] = np.frombuffer(
+            hashlib.sha512(prefix + rows[i].tobytes()).digest(), dtype=np.uint8
+        )
+    return out

@@ -1,0 +1,65 @@
+"""Batched GG18 engine: full 2-of-3 signing over a (tiny) session batch.
+
+Uses 1024-bit Paillier/NTilde keys and shrunk ZK exponent domains
+(test-only: proof algebra is size-independent; bounds still satisfy the
+no-wrap requirement a·b + β′ < N). The full-size path runs in bench.py on
+real hardware.
+"""
+import secrets
+
+import numpy as np
+import pytest
+
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.core import paillier as pl
+from mpcium_tpu.engine import gg18_batch as gb
+
+TEST_DOM = gb.Domains(alpha=600, beta_prime=320, gamma_bob=600)
+
+
+@pytest.fixture(scope="module")
+def small_preparams():
+    out = {}
+    for pid in ("node0", "node1"):
+        P = pl.gen_safe_prime(512)
+        Qp = pl.gen_safe_prime(512)
+        while Qp == P:
+            Qp = pl.gen_safe_prime(512)
+        out[pid] = pl.gen_preparams(bits=1024, safe_primes=(P, Qp))
+    return out
+
+
+def test_batched_gg18_end_to_end(small_preparams):
+    B = 2
+    universe = ["node0", "node1", "node2"]
+    shares = gb.dealer_keygen_secp_batch(B, universe, threshold=1)
+    signer = gb.GG18BatchCoSigners(
+        ["node0", "node1"], shares[:2], small_preparams, dom=TEST_DOM
+    )
+    digests = np.frombuffer(secrets.token_bytes(B * 32), dtype=np.uint8).reshape(
+        B, 32
+    )
+    out = signer.sign(digests)
+    assert out["ok"].all(), "batched GG18 produced invalid signatures"
+    for i in range(B):
+        pub = hm.secp_decompress(shares[0][i].public_key)
+        r = int.from_bytes(out["r"][i].tobytes(), "big")
+        s = int.from_bytes(out["s"][i].tobytes(), "big")
+        digest = int.from_bytes(digests[i].tobytes(), "big")
+        assert s <= gb.Q // 2
+        assert hm.ecdsa_verify(pub, digest, r, s)
+        assert int(out["recovery"][i]) in (0, 1, 2, 3)
+    # independent OpenSSL verification
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec, utils
+
+    pub = hm.secp_decompress(shares[0][0].public_key)
+    key = ec.EllipticCurvePublicNumbers(pub.x, pub.y, ec.SECP256K1()).public_key()
+    key.verify(
+        utils.encode_dss_signature(
+            int.from_bytes(out["r"][0].tobytes(), "big"),
+            int.from_bytes(out["s"][0].tobytes(), "big"),
+        ),
+        digests[0].tobytes(),
+        ec.ECDSA(utils.Prehashed(hashes.SHA256())),
+    )
