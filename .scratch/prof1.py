@@ -1,0 +1,31 @@
+"""Profile core bignum primitives on the real chip."""
+import time, secrets
+import numpy as np, jax, jax.numpy as jnp
+from mpcium_tpu.core import bignum as bn
+
+def timeit(f, *args, n=5):
+    r = f(*args); jax.block_until_ready(r)  # compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+P, Q2 = 1024, 2048
+for nbits in (2048, 4096):
+    prof = bn.LimbProfile(bits=11, n_limbs=-(-nbits//11))
+    mod = secrets.randbits(nbits) | (1 << (nbits-1)) | 1
+    ctx = bn.BarrettCtx(mod, prof)
+    for B in (64, 256, 1024):
+        x = jnp.asarray(bn.batch_to_limbs([secrets.randbelow(mod) for _ in range(B)], prof))
+        y = jnp.asarray(bn.batch_to_limbs([secrets.randbelow(mod) for _ in range(B)], prof))
+        f = jax.jit(ctx.mulmod)
+        t = timeit(f, x, y)
+        print(f"mulmod {nbits}b B={B}: {t*1e3:.2f} ms  ({B/t:.0f} ops/s, {t/B*1e6:.1f} us/op)")
+    # powmod 256-bit exponent at B=256
+    B = 256
+    x = jnp.asarray(bn.batch_to_limbs([secrets.randbelow(mod) for _ in range(B)], prof))
+    ebits = jnp.asarray(np.random.randint(0, 2, size=(B, 256), dtype=np.int32))
+    f2 = jax.jit(ctx.powmod)
+    t = timeit(f2, x, ebits, n=3)
+    print(f"powmod256 {nbits}b B={B}: {t*1e3:.1f} ms ({B/t:.0f} exps/s)")

@@ -1,0 +1,92 @@
+"""Microbench candidate MXU formulations for big-modmul."""
+import time
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+def bench(name, f, *args, n=10):
+    r = jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r); np.asarray(jax.tree_util.tree_leaves(r)[0][0])
+    t = (time.perf_counter()-t0)/n
+    print(f"{name}: {t*1e3:.3f} ms")
+    return t
+
+B = 4096
+rng = np.random.default_rng(0)
+
+# 1. f32 constant matmul: (B,293)@(293,293)  [2048-bit mul-by-const, 7-bit limbs]
+x32 = jnp.asarray(rng.integers(0,127,(B,293)).astype(np.float32))
+C32 = jnp.asarray(rng.integers(0,127,(293,293)).astype(np.float32))
+f = jax.jit(lambda a,b: a@b)
+t = bench("f32 matmul (4096,293)@(293,293)", f, x32, C32)
+print(f"   -> {B*293*293/t/1e12:.2f} TMAC/s")
+
+# 1b. larger: (B,586)@(586,586) [4096-bit domain]
+x32b = jnp.asarray(rng.integers(0,127,(B,586)).astype(np.float32))
+C32b = jnp.asarray(rng.integers(0,127,(586,586)).astype(np.float32))
+t = bench("f32 matmul (4096,586)@(586,586)", f, x32b, C32b)
+print(f"   -> {B*586*586/t/1e12:.2f} TMAC/s")
+
+# 2. int8 dot_general -> int32
+xi8 = jnp.asarray(rng.integers(0,127,(B,586)).astype(np.int8))
+Ci8 = jnp.asarray(rng.integers(0,127,(586,586)).astype(np.int8))
+def dg(a,b):
+    return lax.dot_general(a,b,(((1,),(0,)),((),())), preferred_element_type=jnp.int32)
+f = jax.jit(dg)
+t = bench("int8 dot (4096,586)@(586,586)->int32", f, xi8, Ci8)
+print(f"   -> {B*586*586/t/1e12:.2f} TMAC/s")
+
+# 2b. int8 dot 293
+xi8s = jnp.asarray(rng.integers(0,127,(B,293)).astype(np.int8))
+Ci8s = jnp.asarray(rng.integers(0,127,(293,293)).astype(np.int8))
+t = bench("int8 dot (4096,293)@(293,293)->int32", f, xi8s, Ci8s)
+print(f"   -> {B*293*293/t/1e12:.2f} TMAC/s")
+
+# 3. per-batch conv via conv_general_dilated feature_group trick:
+# lhs (1, B, n), rhs (B, 1, n) feature_group_count=B -> per-element full conv
+def perconv(x, y):
+    # x,y: (B, n) f32. pad y, use conv with feature groups
+    Bn, n = x.shape
+    lhs = x[None]                      # (1, B, n)
+    rhs = y[:, None, ::-1]             # (B, 1, n) kernel flipped
+    out = lax.conv_general_dilated(lhs, rhs, (1,), [(n-1, n-1)],
+                                   feature_group_count=Bn)
+    return out[0]                      # (B, 2n-1)
+f = jax.jit(perconv)
+t = bench("per-elt conv f32 n=586 (grouped)", f, x32b, jnp.asarray(rng.integers(0,127,(B,586)).astype(np.float32)))
+print(f"   -> {B*586*586/t/1e12:.2f} TMAC/s useful")
+
+# 4. carry: scan vs 2-pass roll
+xi = jnp.asarray(rng.integers(0, 2**30, (B, 373), dtype=np.int64).astype(np.int32))
+def carry_scan(x):
+    def step(c, limb):
+        t = limb + c
+        return t >> 11, t & 2047
+    _, out = lax.scan(step, jnp.zeros(x.shape[:-1], jnp.int32), jnp.moveaxis(x,-1,0))
+    return jnp.moveaxis(out, 0, -1)
+f = jax.jit(carry_scan)
+bench("carry scan len373 B=4096", f, xi)
+def carry_roll2(x):
+    for _ in range(2):
+        hi = x >> 11
+        x = (x & 2047) + jnp.pad(hi, ((0,0),(1,0)))[:, :-1]
+    return x
+f = jax.jit(carry_roll2)
+bench("carry 2xroll len373 B=4096", f, xi)
+
+# 5. current einsum block path (one wide mul 4096-bit, int32)
+from mpcium_tpu.core import bignum as bn
+prof11 = bn.LimbProfile(bits=11, n_limbs=373)
+xa = jnp.asarray(rng.integers(0,2047,(B,373)).astype(np.int32))
+xb = jnp.asarray(rng.integers(0,2047,(B,373)).astype(np.int32))
+f = jax.jit(lambda a,b: bn.mul_wide(a,b,prof11))
+bench("current mul_wide int32 4096b B=4096", f, xa, xb, n=3)
+
+# 6. bf16 matmul peak sanity
+xbf = jnp.asarray(rng.standard_normal((4096,1024)).astype(jnp.bfloat16))
+Cbf = jnp.asarray(rng.standard_normal((1024,1024)).astype(jnp.bfloat16))
+f = jax.jit(lambda a,b: (a@b).astype(jnp.float32))
+t = bench("bf16 matmul 4096x1024x1024", f, xbf, Cbf)
+print(f"   -> {4096*1024*1024/t/1e12:.2f} TMAC/s")

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe until the axon TPU responds; log result.
+for i in $(seq 1 600); do
+  timeout 90 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256,256))
+jax.block_until_ready(jax.jit(lambda a: a@a)(x))
+print('TPU-OK', d)
+" >> /root/repo/.scratch/tpu_probe.log 2>&1 && { echo "RECOVERED at $(date)" >> /root/repo/.scratch/tpu_probe.log; exit 0; }
+  echo "probe $i failed $(date)" >> /root/repo/.scratch/tpu_probe.log
+  sleep 60
+done
