@@ -189,27 +189,60 @@ def gen_paillier_key(bits: int = PAILLIER_BITS, rng=secrets) -> PaillierPrivateK
 # ---------------------------------------------------------------------------
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _pool_lock(path):
+    """Exclusive flock guarding pool read-modify-write: two daemons sharing
+    one pool path must never consume the SAME safe primes (shared NTilde
+    factors let each forge the other's MtA range proofs). The lock file and
+    the pool itself are 0600 — the pool holds future secret NTilde factors,
+    same sensitivity as identity keys."""
+    import fcntl
+    import os
+
+    lock_path = str(path) + ".lock"
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o600)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def _pool_write(path, data) -> None:
+    import json
+    import os
+
+    tmp = str(path) + ".tmp"
+    fd = os.open(tmp, os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+
+
 def pool_take(path, count: int = 2, bits: int = 1024, rng=secrets) -> list:
     """Pop ``count`` safe primes from a JSON pool file ({"bits", "safe_primes":
     [str]}), generating fresh ones when the pool is short. The file is
     rewritten without the consumed primes (a prime must never be reused
-    across NTilde moduli). Missing file ⇒ all primes generated fresh."""
+    across NTilde moduli); an exclusive flock serializes concurrent takers.
+    Missing file ⇒ all primes generated fresh."""
     import json
     import os
 
     primes: list = []
-    data = None
     if path is not None and os.path.exists(path):
-        data = json.load(open(path))
-        assert data.get("bits", bits) == bits, "pool bit-size mismatch"
-        avail = [int(p) for p in data.get("safe_primes", [])]
-        take, rest = avail[:count], avail[count:]
-        primes.extend(take)
-        if take:
-            data["safe_primes"] = [str(p) for p in rest]
-            tmp = str(path) + ".tmp"
-            json.dump(data, open(tmp, "w"))
-            os.replace(tmp, path)
+        with _pool_lock(path):
+            data = json.load(open(path))
+            assert data.get("bits", bits) == bits, "pool bit-size mismatch"
+            avail = [int(p) for p in data.get("safe_primes", [])]
+            take, rest = avail[:count], avail[count:]
+            primes.extend(take)
+            if take:
+                data["safe_primes"] = [str(p) for p in rest]
+                _pool_write(path, data)
     while len(primes) < count:
         primes.append(gen_safe_prime(bits, rng))
     return primes
@@ -217,22 +250,29 @@ def pool_take(path, count: int = 2, bits: int = 1024, rng=secrets) -> list:
 
 def pool_fill(path, target: int, bits: int = 1024, rng=secrets) -> int:
     """Top the pool file up to ``target`` primes; returns how many were
-    generated. Run from a background thread / cron on production nodes."""
+    generated. Run from a background thread / cron on production nodes.
+    Prime search happens outside the lock; each append re-takes it."""
     import json
     import os
 
-    data = {"bits": bits, "safe_primes": []}
-    if os.path.exists(path):
-        data = json.load(open(path))
-        assert data.get("bits", bits) == bits
     made = 0
-    while len(data["safe_primes"]) < target:
-        data["safe_primes"].append(str(gen_safe_prime(bits, rng)))
+    while True:
+        with _pool_lock(path):
+            data = {"bits": bits, "safe_primes": []}
+            if os.path.exists(path):
+                data = json.load(open(path))
+                assert data.get("bits", bits) == bits
+            if len(data["safe_primes"]) >= target:
+                return made
+        p = gen_safe_prime(bits, rng)
+        with _pool_lock(path):
+            data = {"bits": bits, "safe_primes": []}
+            if os.path.exists(path):
+                data = json.load(open(path))
+                assert data.get("bits", bits) == bits, "pool bit-size mismatch"
+            data["safe_primes"].append(str(p))
+            _pool_write(path, data)
         made += 1
-        tmp = str(path) + ".tmp"
-        json.dump(data, open(tmp, "w"))
-        os.replace(tmp, path)
-    return made
 
 
 # ---------------------------------------------------------------------------

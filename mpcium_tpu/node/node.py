@@ -90,6 +90,7 @@ class Node:
                 is_reshared=bool(share.aux.get("is_reshared", False)),
                 public_key=share.public_key.hex(),
                 vss_commitments=[c.hex() for c in share.vss_commitments],
+                epoch=share.epoch,
             ),
         )
 
@@ -186,7 +187,19 @@ class Node:
             raise NotEnoughParticipants(
                 f"no {key_type} share for wallet {wallet_id!r} (yet)"
             )
-        session_id = f"sign:{wire._kt(key_type)}:{wallet_id}:{tx_id}"
+        # reshare-epoch fence: a signing request racing a committee rotation
+        # must not build a quorum mixing old- and new-polynomial shares
+        # (reference gates on IsReshared, node.go:149-159). A keyinfo/share
+        # epoch mismatch means this node is mid-rotation — retryable. The
+        # epoch is also baked into the session id and topics below, so nodes
+        # on different epochs can never exchange rounds even transiently.
+        if share.epoch != info.epoch:
+            raise NotEnoughParticipants(
+                f"reshare in progress for {wallet_id!r}: share epoch "
+                f"{share.epoch} != keyinfo epoch {info.epoch}"
+            )
+        epoch_tag = f"{tx_id}~e{share.epoch}" if share.epoch else tx_id
+        session_id = f"sign:{wire._kt(key_type)}:{wallet_id}:{epoch_tag}"
         if key_type == wire.KEY_TYPE_SECP256K1:
             digest = int.from_bytes(tx, "big")
             party = ECDSASigningParty(
@@ -203,8 +216,12 @@ class Node:
             participants=quorum,
             transport=self.transport,
             identity=self.identity,
-            broadcast_topic=wire.sign_broadcast_topic(key_type, wallet_id, tx_id),
-            direct_topic_fn=lambda n: wire.sign_direct_topic(key_type, n, tx_id),
+            broadcast_topic=wire.sign_broadcast_topic(
+                key_type, wallet_id, epoch_tag
+            ),
+            direct_topic_fn=lambda n: wire.sign_direct_topic(
+                key_type, n, epoch_tag
+            ),
             on_done=on_done,
             on_error=on_error,
         )
@@ -238,7 +255,12 @@ class Node:
         old_share = (
             self.load_share(key_type, wallet_id) if is_old else None
         )
-        session_id = f"resharing:{wire._kt(key_type)}:{wallet_id}"
+        if old_share is not None and old_share.epoch != info.epoch:
+            raise NotEnoughParticipants(
+                f"reshare in progress for {wallet_id!r}: share epoch "
+                f"{old_share.epoch} != keyinfo epoch {info.epoch}"
+            )
+        session_id = f"resharing:{wire._kt(key_type)}:{wallet_id}:e{info.epoch}"
         party = ResharingParty(
             session_id,
             self.node_id,
@@ -252,11 +274,31 @@ class Node:
             or None,
             preparams=self.preparams if key_type == wire.KEY_TYPE_SECP256K1 else None,
             min_paillier_bits=self.min_paillier_bits,
+            old_epoch=info.epoch,
         )
 
         def persist_and_done(share):
             if share is not None:  # new-committee member
                 self.save_share(share, wallet_id)
+            elif party.is_old:
+                # old-only member (excluded from the new committee): its
+                # share is superseded — delete it and move keyinfo to the
+                # new topology so later signing attempts here neither use a
+                # stale polynomial nor list this node as a participant
+                # (reference IsReshared gating, node.go:149-159)
+                self.kvstore.delete(share_key(key_type, wallet_id))
+                self.keyinfo.save(
+                    key_type,
+                    wallet_id,
+                    KeyInfo(
+                        participant_peer_ids=list(party.new_committee),
+                        threshold=party.new_threshold,
+                        is_reshared=True,
+                        public_key=info.public_key,
+                        vss_commitments=[c.hex() for c in party.new_agg or []],
+                        epoch=party.new_epoch,
+                    ),
+                )
             if on_done:
                 on_done(share)
 

@@ -137,6 +137,11 @@ class KeygenShare:
     vss_commitments: List[bytes] = field(default_factory=list)  # aggregated
     participants: List[str] = field(default_factory=list)
     threshold: int = 0
+    # resharing generation: 0 at keygen, +1 per committee rotation. Signing
+    # sessions are fenced on (epoch in keyinfo) == (epoch in share) so a
+    # quorum can never mix shares from different polynomials (the reference
+    # gates on IsReshared, node.go:149-159; an epoch counter subsumes it)
+    epoch: int = 0
     aux: Dict[str, Any] = field(default_factory=dict)  # scheme-specific
 
     def to_json(self) -> Dict[str, Any]:
@@ -148,6 +153,7 @@ class KeygenShare:
             "vss_commitments": [c.hex() for c in self.vss_commitments],
             "participants": self.participants,
             "threshold": self.threshold,
+            "epoch": self.epoch,
             "aux": self.aux,
         }
 
@@ -161,5 +167,6 @@ class KeygenShare:
             vss_commitments=[bytes.fromhex(c) for c in d["vss_commitments"]],
             participants=list(d["participants"]),
             threshold=d["threshold"],
+            epoch=int(d.get("epoch", 0)),
             aux=dict(d.get("aux", {})),
         )

@@ -109,13 +109,21 @@ class ECDSASigningParty(PartyBase):
 
         self._stage = 0  # last completed send stage (1..9)
 
+    def _bind(self, sender: str) -> bytes:
+        """Session+sender binding for signing commitments and PoKs — a
+        malicious signer cannot replay another party's R1 Γ-commitment or
+        R4/R6 decommit+PoK as its own (that would only cause an abort with
+        the wrong culprit, but culprit attribution must be right; keygen
+        already binds via _proof_bind)."""
+        return f"{self.session_id}:{sender}".encode()
+
     # -- round 1 ------------------------------------------------------------
 
     def start(self) -> List[RoundMsg]:
         self.k_i = self.rng.randbelow(Q - 1) + 1
         self.gamma_i = self.rng.randbelow(Q - 1) + 1
         self.Gamma_i = hm.secp_mul(self.gamma_i, hm.SECP_G)
-        data = hm.secp_compress(self.Gamma_i)
+        data = self._bind(self.self_id) + hm.secp_compress(self.Gamma_i)
         self._gamma_commit, self._gamma_blind = cm.commit(data, rng=self.rng)
 
         out = [self.broadcast(R1_COMMIT, {"commitment": self._gamma_commit.hex()})]
@@ -248,7 +256,7 @@ class ECDSASigningParty(PartyBase):
     def _round4(self) -> RoundMsg:
         pok = SchnorrProof.prove(
             self.gamma_i, self.Gamma_i, rng=self.rng,
-            bind=self.session_id.encode(),
+            bind=self._bind(self.self_id),
         )
         return self.broadcast(
             R4,
@@ -280,7 +288,7 @@ class ECDSASigningParty(PartyBase):
             if not cm.verify(
                 bytes.fromhex(commits[pid]["commitment"]),
                 bytes.fromhex(decommits[pid]["blind"]),
-                gb,
+                self._bind(pid) + gb,
             ):
                 raise ProtocolError("Γ decommitment mismatch", pid)
             try:
@@ -288,7 +296,7 @@ class ECDSASigningParty(PartyBase):
             except ValueError as e:
                 raise ProtocolError(f"bad Γ point: {e}", pid)
             if not SchnorrProof.from_json(decommits[pid]["pok"]).verify(
-                Gamma_j, bind=self.session_id.encode()
+                Gamma_j, bind=self._bind(pid)
             ):
                 raise ProtocolError("Γ PoK failed", pid)
             Gamma = hm.secp_add(Gamma, Gamma_j)
@@ -307,7 +315,11 @@ class ECDSASigningParty(PartyBase):
             hm.secp_mul(self._s_i, R), hm.secp_mul(self._l_i, hm.SECP_G)
         )
         self._A_i = hm.secp_mul(self._rho_i, hm.SECP_G)
-        data = hm.secp_compress(self._V_i) + hm.secp_compress(self._A_i)
+        data = (
+            self._bind(self.self_id)
+            + hm.secp_compress(self._V_i)
+            + hm.secp_compress(self._A_i)
+        )
         self._va_commit, self._va_blind = cm.commit(data, rng=self.rng)
         return self.broadcast(R5, {"commitment": self._va_commit.hex()})
 
@@ -316,7 +328,7 @@ class ECDSASigningParty(PartyBase):
     def _round6(self) -> RoundMsg:
         pok = PedersenPoK.prove(
             self._s_i, self._l_i, self._R, self._V_i, rng=self.rng,
-            bind=self.session_id.encode(),
+            bind=self._bind(self.self_id),
         )
         return self.broadcast(
             R6,
@@ -342,7 +354,7 @@ class ECDSASigningParty(PartyBase):
             if not cm.verify(
                 bytes.fromhex(commits[pid]["commitment"]),
                 bytes.fromhex(decommits[pid]["blind"]),
-                Vb + Ab,
+                self._bind(pid) + Vb + Ab,
             ):
                 raise ProtocolError("V/A decommitment mismatch", pid)
             try:
@@ -351,7 +363,7 @@ class ECDSASigningParty(PartyBase):
             except ValueError as e:
                 raise ProtocolError(f"bad V/A point: {e}", pid)
             if not PedersenPoK.from_json(decommits[pid]["pok"]).verify(
-                self._R, V_j, bind=self.session_id.encode()
+                self._R, V_j, bind=self._bind(pid)
             ):
                 raise ProtocolError("V_i PoK failed", pid)
             self._peer_VA[pid] = (V_j, A_j)
@@ -368,7 +380,11 @@ class ECDSASigningParty(PartyBase):
         )
         self._U_i = hm.secp_mul(self._rho_i, V)
         self._T_i = hm.secp_mul(self._l_i, A_sum)
-        data = hm.secp_compress(self._U_i) + hm.secp_compress(self._T_i)
+        data = (
+            self._bind(self.self_id)
+            + hm.secp_compress(self._U_i)
+            + hm.secp_compress(self._T_i)
+        )
         self._ut_commit, self._ut_blind = cm.commit(data, rng=self.rng)
         return self.broadcast(R7, {"commitment": self._ut_commit.hex()})
 
@@ -397,7 +413,7 @@ class ECDSASigningParty(PartyBase):
             if not cm.verify(
                 bytes.fromhex(commits[pid]["commitment"]),
                 bytes.fromhex(decommits[pid]["blind"]),
-                Ub + Tb,
+                self._bind(pid) + Ub + Tb,
             ):
                 raise ProtocolError("U/T decommitment mismatch", pid)
             try:

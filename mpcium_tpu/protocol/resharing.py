@@ -113,11 +113,18 @@ class ResharingParty(PartyBase):
         preparams: Optional[PreParams] = None,
         rng=None,
         min_paillier_bits: int = 2046,
+        old_epoch: int = 0,
     ):
         import secrets as _secrets
 
         all_ids = sorted(set(old_quorum) | set(new_committee))
         super().__init__(session_id, self_id, all_ids, rng or _secrets)
+        self.old_epoch = old_epoch
+        self.new_epoch = old_epoch + 1
+        # populated at finalize for ALL roles (old-only members recompute it
+        # from the R1/R2 broadcasts) so every participant can move its
+        # keyinfo to the new topology
+        self.new_agg: Optional[List[bytes]] = None
         self.ops = curve_ops(key_type)
         self.key_type = key_type
         self.old_quorum = sorted(old_quorum)
@@ -245,7 +252,13 @@ class ResharingParty(PartyBase):
         if self._round_full(R3_CONFIRM, expect_new) and (
             not self.is_new or self._sent_r3
         ):
-            self._finalize()
+            # old-only members also need the full R1/R2 broadcast set to
+            # recompute the new commitments in _finalize
+            if self.is_new or (
+                self._round_full(R1, expect_old)
+                and self._round_full(R2_DECOMMIT, expect_old)
+            ):
+                self._finalize()
         return out
 
     # -- new-member verification + confirm ----------------------------------
@@ -401,6 +414,26 @@ class ResharingParty(PartyBase):
             raise ProtocolError("new committee disagrees on reshared key")
 
         if not self.is_new:
+            # old-only member: recompute the new aggregated commitments from
+            # the R1/R2 broadcasts (it saw them as a dealer) and check them
+            # against the new committee's confirm digest, so its keyinfo can
+            # follow the rotation even though it holds no new share
+            all_points = self._redeal_points()
+            agg = []
+            for k in range(self.new_threshold + 1):
+                acc = self.ops.identity
+                for pid in self.old_quorum:
+                    acc = self.ops.add(acc, all_points[pid][k])
+                agg.append(acc)
+            new_agg = [self.ops.compress(p) for p in agg]
+            digest = hashlib.sha256(
+                b"reshare-confirm"
+                + self.ops.compress(self.ops.decompress(self.old_public_key))
+                + b"".join(new_agg)
+            ).hexdigest()
+            if digests and digests != {digest}:
+                raise ProtocolError("confirm digest mismatch (old-only view)")
+            self.new_agg = new_agg
             self.result = None
             self.done = True
             return
@@ -436,6 +469,7 @@ class ResharingParty(PartyBase):
                     },
                 }
             )
+        self.new_agg = list(self._new_agg)
         self.result = KeygenShare(
             key_type=self.key_type,
             share=self._x_new,
@@ -446,6 +480,7 @@ class ResharingParty(PartyBase):
             vss_commitments=self._new_agg,
             participants=list(self.new_committee),
             threshold=self.new_threshold,
+            epoch=self.new_epoch,
             aux=aux,
         )
         self.done = True

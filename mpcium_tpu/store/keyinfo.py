@@ -23,6 +23,9 @@ class KeyInfo:
     is_reshared: bool = False
     public_key: str = ""  # hex compressed
     vss_commitments: List[str] = field(default_factory=list)  # hex
+    # resharing generation (see protocol.base.KeygenShare.epoch): signing is
+    # fenced on keyinfo.epoch == share.epoch
+    epoch: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -31,6 +34,7 @@ class KeyInfo:
             "is_reshared": self.is_reshared,
             "public_key": self.public_key,
             "vss_commitments": self.vss_commitments,
+            "epoch": self.epoch,
         }
 
     @classmethod
@@ -41,6 +45,7 @@ class KeyInfo:
             is_reshared=bool(d.get("is_reshared", False)),
             public_key=d.get("public_key", ""),
             vss_commitments=list(d.get("vss_commitments", [])),
+            epoch=int(d.get("epoch", 0)),
         )
 
 
