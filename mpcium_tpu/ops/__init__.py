@@ -1,0 +1,10 @@
+"""TPU-optimised modular-arithmetic kernels (MXU formulations).
+
+``modmul`` carries the Barrett context whose constant multiplies ride the
+MXU as Toeplitz matmuls and whose carries use a logarithmic lookahead —
+the execution engine under the batched GG18 signing path (the tss-lib
+Paillier/MtA arithmetic of SURVEY.md §2.3, batched over sessions).
+"""
+from .modmul import MXUBarrett, carry, mul_const, mul_pair, profile
+
+__all__ = ["MXUBarrett", "carry", "mul_const", "mul_pair", "profile"]

@@ -1,0 +1,163 @@
+"""Batched Paillier on the MXU kernels (the GG18 signing hot path).
+
+Three measured-cost optimizations over core.paillier.PaillierBatch (which
+drives the generic 11-bit einsum path with full-width exponents):
+
+1. **Short-randomizer encryption.** Enc(m; r) = (1+mN)·r^N mod N² costs a
+   2048-bit exponentiation. Fix a random unit y at key load and precompute
+   h = y^N mod N²; then for a short uniform u (2·security = 256 bits),
+   r = y^u and r^N = h^u — both 256-bit FIXED-BASE exponentiations
+   (comb tables, one mulmod per 4-bit window ⇒ 64 + 64 mulmods instead of
+   ~3000). Statistically the randomizer ranges over a 2^256-size subgroup
+   of the units: ciphertext indistinguishability follows from DCR + the
+   standard short-exponent assumption; the MtA/range-proof algebra is
+   unchanged because the proofs only ever use the VALUE r = y^u mod N.
+2. **CRT decryption.** Dec(c) works mod p² and q² (2048-bit contexts, half
+   the limb width of N²) with 1024-bit constant exponents p-1, q-1, then a
+   CRT combine mod q — ~3× cheaper than c^λ mod N².
+3. **All multiplies ride ops.modmul** (MXU Toeplitz const-muls, lookahead
+   carries).
+
+Reference correspondence: tss-lib's paillier.{EncryptAndReturnRandomness,
+Decrypt} under the GG18 rounds (SURVEY.md §2.3); the per-session Go path
+becomes one fused dispatch over the session batch.
+"""
+from __future__ import annotations
+
+import secrets
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import bignum as bn
+from ..core.paillier import PaillierPrivateKey, PaillierPublicKey
+from . import modmul as mm
+
+RAND_BITS = 256  # short-randomizer exponent width (2 × 128-bit security)
+
+
+class PaillierMXU:
+    """Batched Paillier for one public key over a session axis."""
+
+    def __init__(self, pk: PaillierPublicKey, y: Optional[int] = None,
+                 rng=secrets):
+        self.pk = pk
+        self.ctx_N = mm.MXUBarrett(pk.N)
+        self.ctx_N2 = mm.MXUBarrett(pk.N2)
+        self.prof_n = self.ctx_N.prof
+        self.prof_n2 = self.ctx_N2.prof
+        # short-randomizer base: y uniform unit mod N (gcd≠1 ⇒ factoring N)
+        self.y = y if y is not None else (rng.randbelow(pk.N - 2) + 2)
+        self.h = pow(self.y, pk.N, pk.N2)
+        self._N_T = mm._const_matrices(pk.N, self.prof_n.n_limbs)
+
+    # -- host <-> device ----------------------------------------------------
+
+    def to_limbs_N(self, xs) -> np.ndarray:
+        return bn.batch_to_limbs(xs, self.prof_n)
+
+    def to_limbs_N2(self, xs) -> np.ndarray:
+        return bn.batch_to_limbs(xs, self.prof_n2)
+
+    def from_limbs_N(self, arr) -> list:
+        return bn.batch_from_limbs(np.asarray(arr), self.prof_n)
+
+    def from_limbs_N2(self, arr) -> list:
+        return bn.batch_from_limbs(np.asarray(arr), self.prof_n2)
+
+    # -- kernels ------------------------------------------------------------
+
+    def enc_deterministic(self, m_limbs: jnp.ndarray) -> jnp.ndarray:
+        """(1 + m·N) mod N² for m < N (the g^m leg; exact, no reduction
+        needed since (1+mN) < N²)."""
+        mN = mm.carry(mm.mul_const(m_limbs, self._N_T))
+        out = bn.take_limbs(mN, 0, self.prof_n2.n_limbs).at[..., 0].add(1)
+        return mm.carry(out)
+
+    def encrypt(
+        self, m_limbs: jnp.ndarray, u_bits: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """c = (1+mN)·h^u mod N², r = y^u mod N.
+
+        ``u_bits`` (..., RAND_BITS) int32 CSPRNG bits. Returns (c, r); r is
+        the effective Paillier randomizer (c == (1+mN)·r^N), which the MtA
+        range proofs consume.
+        """
+        hu = self.ctx_N2.powmod_fixed_base(self.h, u_bits)
+        c = self.ctx_N2.mulmod(self.enc_deterministic(m_limbs), hu)
+        r = self.ctx_N.powmod_fixed_base(self.y % self.pk.N, u_bits)
+        return c, r
+
+    def add(self, c1: jnp.ndarray, c2: jnp.ndarray) -> jnp.ndarray:
+        return self.ctx_N2.mulmod(c1, c2)
+
+    def scalar_mul(self, c: jnp.ndarray, k_bits: jnp.ndarray) -> jnp.ndarray:
+        return self.ctx_N2.powmod(c, k_bits)
+
+
+class PaillierMXUPrivate(PaillierMXU):
+    """Adds CRT decryption (private-key holder side)."""
+
+    def __init__(self, sk: PaillierPrivateKey, y: Optional[int] = None,
+                 rng=secrets):
+        super().__init__(sk.public, y=y, rng=rng)
+        self.sk = sk
+        p, q = sk.p, sk.q
+        self.ctx_p2 = mm.MXUBarrett(p * p)
+        self.ctx_q2 = mm.MXUBarrett(q * q)
+        self.ctx_p = mm.MXUBarrett(p)
+        self.ctx_q = mm.MXUBarrett(q)
+        # L_p(x) = (x-1)/p as multiplication by p^-1 mod R^k (x-1 is an
+        # exact multiple of p, so the low limbs of the product are exact)
+        kp = self.ctx_p2.prof.n_limbs
+        kq = self.ctx_q2.prof.n_limbs
+        Rp = 1 << (mm.LIMB_BITS * kp)
+        Rq = 1 << (mm.LIMB_BITS * kq)
+        self._pinv_T = mm._const_matrices(pow(p, -1, Rp), kp)
+        self._qinv_T = mm._const_matrices(pow(q, -1, Rq), kq)
+        # h_p = L_p((1+N)^(p-1) mod p²)^-1 mod p, and mod-q twin
+        def _L(x: int, r: int) -> int:
+            return (x - 1) // r
+
+        self.h_p = pow(_L(pow(1 + sk.N, p - 1, p * p), p), -1, p)
+        self.h_q = pow(_L(pow(1 + sk.N, q - 1, q * q), q), -1, q)
+        # CRT combine: m = m_p + p·((m_q - m_p)·p^-1 mod q)
+        self.p_inv_mod_q = pow(p, -1, q)
+        self._p_T_wide = mm._const_matrices(p, self.ctx_q.prof.n_limbs)
+
+    def _half_decrypt(self, c, ctx2, ctx1, r: int, hr: int, inv_T) -> jnp.ndarray:
+        """m_r = L_r(c^(r-1) mod r²)·h_r mod r → limbs in ctx1's profile."""
+        u = ctx2.powmod_const_exp(ctx2.reduce(c), r - 1)
+        # u - 1 via the complement trick (u-1 may have long borrow chains,
+        # which the fast lookahead carry does not handle): u + (R^k - 1)
+        # mod R^k == u - 1 for u ≥ 1.
+        k = ctx2.prof.n_limbs
+        u_minus = mm.carry(bn.pad_limbs(u + mm.MASK, 1))[..., :k]
+        L = mm.carry(mm.mul_const(u_minus, inv_T))[..., :k]
+        # exact division: L = (u-1)/r < r — fits the mod-r context
+        return ctx1.mulmod_const(bn.take_limbs(L, 0, ctx1.prof.n_limbs), hr)
+
+    def decrypt(self, c: jnp.ndarray) -> jnp.ndarray:
+        """Batched CRT decrypt → plaintext limbs mod N (prof_n)."""
+        sk = self.sk
+        p, q = sk.p, sk.q
+        m_p = self._half_decrypt(
+            c, self.ctx_p2, self.ctx_p, p, self.h_p, self._pinv_T
+        )
+        m_q = self._half_decrypt(
+            c, self.ctx_q2, self.ctx_q, q, self.h_q, self._qinv_T
+        )
+        # t = (m_q - m_p) · p^-1 mod q
+        nq = self.ctx_q.prof.n_limbs
+        mq_q = self.ctx_q.reduce(bn.take_limbs(m_q, 0, nq))
+        mp_q = self.ctx_q.reduce(bn.take_limbs(m_p, 0, nq))
+        t = self.ctx_q.mulmod_const(
+            self.ctx_q.submod(mq_q, mp_q), self.p_inv_mod_q
+        )
+        # m = m_p + p·t  (< p·q = N; exact, no modular reduction needed)
+        pt = mm.carry(mm.mul_const(t, self._p_T_wide))
+        n = self.prof_n.n_limbs
+        return mm.carry(
+            bn.take_limbs(pt, 0, n) + bn.take_limbs(m_p, 0, n)
+        )

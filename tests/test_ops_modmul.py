@@ -1,0 +1,131 @@
+"""Property tests: ops.modmul MXU kernels vs python-int ground truth."""
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpcium_tpu.core import bignum as bn
+from mpcium_tpu.ops import modmul as mm
+
+
+def _batch(xs, prof):
+    return jnp.asarray(bn.batch_to_limbs(xs, prof))
+
+
+def _ints(arr, prof):
+    return bn.batch_from_limbs(np.asarray(arr), prof)
+
+
+@pytest.fixture(
+    scope="module",
+    params=[
+        256,
+        pytest.param(1024, marks=pytest.mark.slow),
+        pytest.param(2048, marks=pytest.mark.slow),
+    ],
+)
+def ctx(request):
+    bits = request.param
+    mod = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+    return mm.MXUBarrett(mod)
+
+
+def test_carry_matches_bignum():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 1 << 24, (16, 96)).astype(np.int32)
+    prof = bn.LimbProfile(bits=7, n_limbs=96)
+    got = np.asarray(mm.carry(jnp.asarray(x)))
+    ref = np.asarray(bn.carry(jnp.asarray(x), prof))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_mul_const_exact(ctx):
+    B = 8
+    xs = [secrets.randbelow(ctx.modulus) for _ in range(B)]
+    c = secrets.randbelow(ctx.modulus)
+    T = mm._const_matrices(c, ctx.prof.n_limbs)
+    out = mm.carry(mm.mul_const(_batch(xs, ctx.prof), T))
+    prof_wide = bn.LimbProfile(bits=7, n_limbs=out.shape[-1])
+    got = _ints(out, prof_wide)
+    assert got == [x * c for x in xs]
+
+
+def test_mulmod(ctx):
+    B = 8
+    m = ctx.modulus
+    xs = [secrets.randbelow(m) for _ in range(B)]
+    ys = [secrets.randbelow(m) for _ in range(B)]
+    got = _ints(ctx.mulmod(_batch(xs, ctx.prof), _batch(ys, ctx.prof)), ctx.prof)
+    assert got == [x * y % m for x, y in zip(xs, ys)]
+
+
+def test_add_sub_neg(ctx):
+    B = 8
+    m = ctx.modulus
+    xs = [secrets.randbelow(m) for _ in range(B)]
+    ys = [secrets.randbelow(m) for _ in range(B)]
+    X, Y = _batch(xs, ctx.prof), _batch(ys, ctx.prof)
+    assert _ints(ctx.addmod(X, Y), ctx.prof) == [
+        (x + y) % m for x, y in zip(xs, ys)
+    ]
+    assert _ints(ctx.submod(X, Y), ctx.prof) == [
+        (x - y) % m for x, y in zip(xs, ys)
+    ]
+    assert _ints(ctx.negmod(X), ctx.prof) == [(-x) % m for x in xs]
+
+
+def test_powmod_const_exp(ctx):
+    B = 4
+    m = ctx.modulus
+    xs = [secrets.randbelow(m) for _ in range(B)]
+    e = secrets.randbits(80)
+    got = _ints(ctx.powmod_const_exp(_batch(xs, ctx.prof), e), ctx.prof)
+    assert got == [pow(x, e, m) for x in xs]
+
+
+def test_powmod_per_element(ctx):
+    B = 4
+    m = ctx.modulus
+    xs = [secrets.randbelow(m) for _ in range(B)]
+    es = [secrets.randbits(64) for _ in range(B)]
+    ebits = jnp.asarray(
+        np.stack([[(e >> i) & 1 for i in range(64)] for e in es]).astype(
+            np.int32
+        )
+    )
+    got = _ints(ctx.powmod(_batch(xs, ctx.prof), ebits), ctx.prof)
+    assert got == [pow(x, e, m) for x, e in zip(xs, es)]
+
+
+def test_powmod_fixed_base(ctx):
+    B = 4
+    m = ctx.modulus
+    g = secrets.randbelow(m - 2) + 2
+    es = [secrets.randbits(96) for _ in range(B)]
+    ebits = jnp.asarray(
+        np.stack([[(e >> i) & 1 for i in range(96)] for e in es]).astype(
+            np.int32
+        )
+    )
+    got = _ints(ctx.powmod_fixed_base(g, ebits), ctx.prof)
+    assert got == [pow(g, e, m) for e in es]
+
+
+def test_prod_over_batch(ctx):
+    B = 7  # odd on purpose
+    m = ctx.modulus
+    xs = [secrets.randbelow(m) for _ in range(B)]
+    got = _ints(ctx.prod_over_batch(_batch(xs, ctx.prof))[None], ctx.prof)[0]
+    want = 1
+    for x in xs:
+        want = want * x % m
+    assert got == want
+
+
+def test_edge_values(ctx):
+    m = ctx.modulus
+    xs = [0, 1, m - 1, m // 2]
+    X = _batch(xs, ctx.prof)
+    assert _ints(ctx.mulmod(X, X), ctx.prof) == [x * x % m for x in xs]
+    assert _ints(ctx.addmod(X, X), ctx.prof) == [2 * x % m for x in xs]
