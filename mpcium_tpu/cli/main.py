@@ -7,6 +7,7 @@ provides a working console entry.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from mpcium_tpu import __version__
@@ -32,6 +33,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     broker.add_argument("--host", default="127.0.0.1")
     broker.add_argument("--port", type=int, default=4333)
+    broker.add_argument(
+        "--journal", default="",
+        help="queue journal path (durable work queues; '' = in-memory)",
+    )
+    broker.add_argument(
+        "--token", default=os.environ.get("MPCIUM_BROKER_TOKEN", ""),
+        help="shared auth token (or MPCIUM_BROKER_TOKEN)",
+    )
     sub.add_parser("version", help="print version")
     return p
 
@@ -53,7 +62,8 @@ def main(argv=None) -> int:
     if args.command == "broker":
         from mpcium_tpu.node.daemon import run_broker
 
-        return run_broker(host=args.host, port=args.port)
+        return run_broker(host=args.host, port=args.port,
+                          journal=args.journal, token=args.token)
     build_parser().print_help()
     return 1
 

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field, fields
 from pathlib import Path
 from typing import Any, Dict, Optional
 
-_SECRET_KEYS = {"badger_password", "passphrase"}
+_SECRET_KEYS = {"badger_password", "passphrase", "broker_token"}
 
 
 @dataclass
@@ -28,6 +28,8 @@ class AppConfig:
     passphrase: str = ""  # identity decryption (or prompt)
     broker_host: str = "127.0.0.1"  # TCP bus (the NATS analogue)
     broker_port: int = 4333
+    broker_token: str = ""  # shared auth token (reference NATS credentials)
+    broker_journal: str = ""  # queue journal path ("" = in-memory queues)
     peers_file: str = "peers.json"
 
     def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:

@@ -25,7 +25,7 @@ from typing import Dict, Optional
 
 from .. import wire
 from ..node.node import Node, NotEnoughParticipants
-from ..node.session import Session
+from ..node.session import RetryableSessionError, Session
 from ..transport.api import Transport
 from ..utils import log
 
@@ -249,6 +249,14 @@ class EventConsumer:
                 self._finish(dedup)
 
         def on_error(e):
+            if isinstance(e, RetryableSessionError):
+                # e.g. hello-barrier deadline: leave the durable request
+                # un-acked (no reply, no result event) so the queue
+                # redelivers and a later attempt can gather the quorum
+                log.warn("signing retryable failure", wallet=msg.wallet_id,
+                         tx=msg.tx_id, reason=str(e))
+                self._finish(dedup)
+                return
             emit_error(str(e))
             self._finish(dedup)
 

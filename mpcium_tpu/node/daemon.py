@@ -77,7 +77,10 @@ def run_node(
         initiator_pubkey=bytes.fromhex(cfg.event_initiator_pubkey),
         passphrase=passphrase,
     )
-    transport = tcp_transport(cfg.broker_host, cfg.broker_port)
+    transport = tcp_transport(
+        cfg.broker_host, cfg.broker_port,
+        auth_token=cfg.broker_token or None,
+    )
     registry = PeerRegistry(name, list(peers), control_kv)
     node = Node(
         node_id=name,
@@ -116,11 +119,24 @@ def run_node(
     return 0
 
 
-def run_broker(host: str = "127.0.0.1", port: int = 4333, block: bool = True):
-    """The `nats-server` analogue: `mpcium-tpu broker`."""
+def run_broker(
+    host: str = "127.0.0.1",
+    port: int = 4333,
+    block: bool = True,
+    journal: str = "",
+    token: str = "",
+):
+    """The `nats-server` analogue: `mpcium-tpu broker`. CLI flags win;
+    otherwise config.yaml's broker_journal/broker_token apply."""
+    from ..config import init_config
     from ..transport.tcp import BrokerServer
 
-    broker = BrokerServer(host=host, port=port)
+    cfg = init_config()
+    broker = BrokerServer(
+        host=host, port=port,
+        journal_path=journal or cfg.broker_journal or None,
+        auth_token=token or cfg.broker_token or None,
+    )
     log.init()
     log.info("broker listening", host=broker.host, port=broker.port)
     if not block:

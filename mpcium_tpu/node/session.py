@@ -34,6 +34,13 @@ class SessionError(Exception):
         self.culprit = culprit
 
 
+class RetryableSessionError(SessionError):
+    """Transient failure (e.g. quorum peers never said hello inside the
+    barrier deadline): the triggering event should be redelivered, not
+    surfaced as a terminal error — the reference's un-acked-redelivery
+    philosophy (event_consumer.go:276-280)."""
+
+
 class Session:
     """One protocol run bound to topics.
 
@@ -53,6 +60,7 @@ class Session:
         direct_topic_fn: Callable[[str], str],
         on_done: Optional[Callable[[object], None]] = None,
         on_error: Optional[Callable[[Exception], None]] = None,
+        hello_timeout_s: Optional[float] = 20.0,
     ):
         self.session_id = session_id
         self.party = party
@@ -73,6 +81,8 @@ class Session:
         self.created_at = time.monotonic()
         self.last_activity = self.created_at
         self._done_evt = threading.Event()
+        self.hello_timeout_s = hello_timeout_s
+        self._hello_timer: Optional[threading.Timer] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -88,8 +98,37 @@ class Session:
             )
         )
         self._send_hello()
+        # barrier deadline: a never-arriving quorum peer must fail the
+        # session RETRYABLY within the signing window, not sit buffered
+        # until the 30-minute GC (reference window: 30 s, sign_consumer.go:
+        # 16-20; the deadline here is per-session and shorter)
+        if self.hello_timeout_s is not None:
+            self._hello_timer = threading.Timer(
+                self.hello_timeout_s, self._hello_deadline
+            )
+            self._hello_timer.daemon = True
+            self._hello_timer.start()
+
+    def _hello_deadline(self) -> None:
+        with self._lock:
+            if self._started or self._failed:
+                return
+            # claim the failure INSIDE the same hold that checks _started:
+            # a final hello racing the deadline must not both start and
+            # fail the session
+            self._failed = True
+            missing = sorted(set(self.participants) - self._hellos)
+        self._fail(
+            RetryableSessionError(
+                f"hello barrier timed out after {self.hello_timeout_s}s; "
+                f"missing: {missing}"
+            ),
+            _claimed=True,
+        )
 
     def close(self) -> None:
+        if self._hello_timer is not None:
+            self._hello_timer.cancel()
         for s in self._subs:
             try:
                 s.unsubscribe()
@@ -186,11 +225,14 @@ class Session:
                 self._send_hello()
             if (
                 not self._started
+                and not self._failed
                 and self._hellos >= set(self.participants)
             ):
                 self._started = True
                 start_now = True
         if start_now:
+            if self._hello_timer is not None:
+                self._hello_timer.cancel()
             self._start_party()
 
     def _start_party(self) -> None:
@@ -231,11 +273,12 @@ class Session:
                 log.error("on_done callback failed", session=self.session_id,
                           error=repr(e))
 
-    def _fail(self, e: Exception) -> None:
-        with self._lock:
-            if self._failed:
-                return
-            self._failed = True
+    def _fail(self, e: Exception, _claimed: bool = False) -> None:
+        if not _claimed:
+            with self._lock:
+                if self._failed:
+                    return
+                self._failed = True
         culprit = getattr(e, "culprit", None)
         log.error("session failed", session=self.session_id, node=self.node_id,
                   error=str(e), culprit=culprit or "")

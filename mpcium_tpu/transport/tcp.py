@@ -12,16 +12,28 @@ the same four delivery semantics as the loopback fabric:
   dead-letter broadcast after max_deliver
 - dead-letter events fan out to every connected client that registered
 
+Durability: ``journal_path`` gives the broker an append-only JSONL journal
+of queue state (enqueue / done records). A restarted broker replays it and
+redelivers every enqueued-but-unacked message — the reference's file-backed
+JetStream WorkQueue retention (message_queue.go:56-63). Pub/sub and direct
+traffic stay ephemeral, as in NATS core.
+
+Auth: ``auth_token`` requires every client's first frame to be
+``{"op": "auth", "token": ...}`` (constant-time compare) — the reference's
+NATS user/password credentials (main.go:346-359, config.prod.yaml.template);
+transport encryption remains deployment-level (TLS terminator / private
+network), as with the reference's dev NATS.
+
 Framing: newline-delimited JSON, payloads hex-encoded. This is a dev/ops
 fabric for single-digit node counts (the reference's deployment shape);
-protocol payload sizes are small (keygen/signing round messages). TLS and
-auth ride on deployment-level network isolation, as with the reference's
-dev NATS (production adds TLS config — config.prod.yaml.template).
+protocol payload sizes are small (keygen/signing round messages).
 """
 from __future__ import annotations
 
+import hmac
 import itertools
 import json
+import os
 import socket
 import threading
 import time
@@ -61,6 +73,7 @@ class _Conn:
         self.wants_dead_letters = False
         self.lock = threading.Lock()
         self.alive = True
+        self.authed = False
 
     def send(self, obj: dict) -> bool:
         try:
@@ -78,8 +91,11 @@ class BrokerServer:
         host: str = "127.0.0.1",
         port: int = 0,
         queue_config: QueueConfig = QueueConfig(),
+        journal_path: Optional[str] = None,
+        auth_token: Optional[str] = None,
     ):
         self.queue_config = queue_config
+        self.auth_token = auth_token
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         self._conns: Dict[int, _Conn] = {}
@@ -90,14 +106,65 @@ class BrokerServer:
         # bounded dedup window (JetStream duplicate-window semantics)
         self._dedup_window_s = 120.0
         self._seen_ids: Dict[Tuple[str, str], float] = {}
-        self._pending_q: deque = deque()  # (topic, data, deliveries)
-        self._inflight: Dict[int, Tuple[str, bytes, int, int]] = {}
-        # did -> (topic, data, deliveries, cid)
+        self._pending_q: deque = deque()  # (topic, data, deliveries, mid)
+        self._inflight: Dict[int, Tuple[str, str, int, int, int]] = {}
+        # did -> (topic, data, deliveries, cid, mid)
+        self._mid = itertools.count(1)
+        self._journal = None
+        if journal_path is not None:
+            self._replay_journal(journal_path)
+            self._journal = open(journal_path, "a", buffering=1)
         self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="broker-accept", daemon=True
         )
         self._accept_thread.start()
+
+    # -- durability ---------------------------------------------------------
+
+    def _replay_journal(self, path: str) -> None:
+        """Rebuild pending queue state from the append-only journal, then
+        compact it (pending survivors only). Enqueued-but-not-done messages
+        are redelivered once a consumer subscribes — the reference's
+        file-backed WorkQueue retention (message_queue.go:56-63)."""
+        pending: Dict[int, Tuple[str, str, str]] = {}
+        max_mid = 0
+        if os.path.exists(path):
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write on crash
+                    if rec.get("j") == "enq":
+                        pending[rec["mid"]] = (
+                            rec["topic"], rec["data"], rec.get("key", "")
+                        )
+                        max_mid = max(max_mid, rec["mid"])
+                    elif rec.get("j") == "done":
+                        pending.pop(rec["mid"], None)
+        self._mid = itertools.count(max_mid + 1)
+        tmp = path + ".tmp"
+        now = time.monotonic()
+        with open(tmp, "w") as fh:
+            for mid, (topic, data, key) in sorted(pending.items()):
+                fh.write(json.dumps(
+                    {"j": "enq", "mid": mid, "topic": topic, "data": data,
+                     "key": key}, separators=(",", ":")) + "\n")
+                self._pending_q.append((topic, data, 0, mid))
+                if key:
+                    self._seen_ids[(topic.rsplit(".", 1)[0], key)] = now
+        os.replace(tmp, path)
+
+    def _journal_write(self, rec: dict) -> None:
+        if self._journal is not None:
+            with self._lock:
+                self._journal.write(
+                    json.dumps(rec, separators=(",", ":")) + "\n"
+                )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -113,6 +180,9 @@ class BrokerServer:
                     c.sock.close()
                 except OSError:
                     pass
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
 
     # -- accept/read --------------------------------------------------------
 
@@ -156,14 +226,33 @@ class BrokerServer:
             orphaned = [
                 (did, v) for did, v in self._inflight.items() if v[3] == conn.cid
             ]
-            for did, (topic, data, deliveries, _cid) in orphaned:
+            for did, (topic, data, deliveries, _cid, mid) in orphaned:
                 del self._inflight[did]
-                self._queue_dispatch(topic, data, deliveries)
+                self._queue_dispatch(topic, data, deliveries, mid)
 
     # -- frame handling ------------------------------------------------------
 
     def _handle(self, conn: _Conn, f: dict) -> None:
         op = f.get("op")
+        if self.auth_token is not None and not conn.authed:
+            # first frame must authenticate (reference NATS credentials,
+            # main.go:346-359); constant-time compare, then drop on failure
+            if op == "auth" and hmac.compare_digest(
+                str(f.get("token", "")), self.auth_token
+            ):
+                conn.authed = True
+                conn.send({"op": "auth_ok"})
+            else:
+                log.warn("broker: unauthenticated client rejected")
+                try:
+                    conn.send({"op": "auth_err"})
+                    conn.sock.close()
+                except OSError:
+                    pass
+            return
+        if op == "auth":
+            conn.send({"op": "auth_ok"})  # auth disabled: accept anything
+            return
         if op == "sub":
             with self._lock:
                 conn.subs[f["sid"]] = (f["kind"], f["pattern"])
@@ -194,21 +283,30 @@ class BrokerServer:
                     if dk in self._seen_ids:
                         return
                     self._seen_ids[dk] = now
-            self._queue_dispatch(f["topic"], f["data"], 0)
+            mid = next(self._mid)
+            self._journal_write(
+                {"j": "enq", "mid": mid, "topic": f["topic"],
+                 "data": f["data"], "key": key}
+            )
+            self._queue_dispatch(f["topic"], f["data"], 0, mid)
         elif op == "qack":
             with self._lock:
-                self._inflight.pop(f["did"], None)
+                v = self._inflight.pop(f["did"], None)
+            if v:
+                self._journal_write({"j": "done", "mid": v[4]})
         elif op == "qnak":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
             if v:
-                topic, data, deliveries, _cid = v
+                topic, data, deliveries, _cid, mid = v
                 if f.get("permanent"):
+                    self._journal_write({"j": "done", "mid": mid})
                     return
                 if deliveries >= self.queue_config.max_deliver:
+                    self._journal_write({"j": "done", "mid": mid})
                     self._dead_letter(topic, data, deliveries)
                 else:
-                    self._queue_dispatch(topic, data, deliveries)
+                    self._queue_dispatch(topic, data, deliveries, mid)
 
     # -- pub/sub -------------------------------------------------------------
 
@@ -254,7 +352,9 @@ class BrokerServer:
 
     # -- queues --------------------------------------------------------------
 
-    def _queue_dispatch(self, topic: str, data_hex: str, deliveries: int) -> None:
+    def _queue_dispatch(
+        self, topic: str, data_hex: str, deliveries: int, mid: int
+    ) -> None:
         with self._lock:
             targets = [
                 (c, sid)
@@ -263,23 +363,23 @@ class BrokerServer:
                 if kind == "queue" and topic_matches(pat, topic)
             ]
             if not targets:
-                self._pending_q.append((topic, data_hex, deliveries))
+                self._pending_q.append((topic, data_hex, deliveries, mid))
                 return
             c, sid = targets[next(self._rr) % len(targets)]
             did = next(self._did)
-            self._inflight[did] = (topic, data_hex, deliveries + 1, c.cid)
+            self._inflight[did] = (topic, data_hex, deliveries + 1, c.cid, mid)
         if not c.send(
             {"op": "qmsg", "sid": sid, "did": did, "data": data_hex, "topic": topic}
         ):
             with self._lock:
                 self._inflight.pop(did, None)
-            self._queue_dispatch(topic, data_hex, deliveries)
+            self._queue_dispatch(topic, data_hex, deliveries, mid)
 
     def _flush_pending(self) -> None:
         with self._lock:
             pending, self._pending_q = list(self._pending_q), deque()
-        for topic, data_hex, deliveries in pending:
-            self._queue_dispatch(topic, data_hex, deliveries)
+        for topic, data_hex, deliveries, mid in pending:
+            self._queue_dispatch(topic, data_hex, deliveries, mid)
 
     def _dead_letter(self, topic: str, data_hex: str, deliveries: int) -> None:
         with self._lock:
@@ -306,7 +406,13 @@ class _ClientSub(Subscription):
 class TcpClient:
     """One broker connection per process; thread-pool handler execution."""
 
-    def __init__(self, host: str, port: int, workers: int = 16):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        workers: int = 16,
+        auth_token: Optional[str] = None,
+    ):
         from concurrent.futures import ThreadPoolExecutor
 
         self.sock = socket.create_connection((host, port), timeout=10)
@@ -325,10 +431,17 @@ class TcpClient:
         self._qpool = ThreadPoolExecutor(max_workers=workers,
                                          thread_name_prefix="tcpbus-q")
         self._closed = False
+        self._auth_evt = threading.Event()
+        self._auth_ok = False
         self._reader = threading.Thread(
             target=self._read_loop, name="tcpbus-read", daemon=True
         )
         self._reader.start()
+        if auth_token is not None:
+            self._send({"op": "auth", "token": auth_token})
+            if not self._auth_evt.wait(10) or not self._auth_ok:
+                self.close()
+                raise TransportError("broker rejected credentials")
 
     def close(self) -> None:
         self._closed = True
@@ -379,6 +492,14 @@ class TcpClient:
 
     def _dispatch(self, f: dict) -> None:
         op = f.get("op")
+        if op == "auth_ok":
+            self._auth_ok = True
+            self._auth_evt.set()
+            return
+        if op == "auth_err":
+            self._auth_ok = False
+            self._auth_evt.set()
+            return
         if op == "msg":
             ent = self._handlers.get(f["sid"])
             if ent:
@@ -481,9 +602,11 @@ class TcpClient:
         self._dead_handlers.append(handler)
 
 
-def tcp_transport(host: str, port: int) -> Transport:
+def tcp_transport(
+    host: str, port: int, auth_token: Optional[str] = None
+) -> Transport:
     """Connect to a broker → a :class:`Transport` bundle."""
-    client = TcpClient(host, port)
+    client = TcpClient(host, port, auth_token=auth_token)
 
     class _PS(PubSub):
         def publish(self, topic, data):

@@ -1,0 +1,80 @@
+"""Hello-barrier deadline: a never-arriving quorum peer fails the session
+retryably within the signing window instead of hanging until the 30-minute
+GC (reference window: sign_consumer.go:16-20)."""
+import threading
+import time
+
+import pytest
+
+from mpcium_tpu.identity.identity import IdentityStore, generate_identity
+from mpcium_tpu.node.session import RetryableSessionError, Session
+from mpcium_tpu.protocol.eddsa.keygen import EDDSAKeygenParty
+from mpcium_tpu.transport.loopback import LoopbackFabric
+
+
+def test_hello_deadline_fires_retryable(tmp_path):
+    ids = ["node0", "node1"]
+    for n in ids:
+        generate_identity(n, tmp_path)
+    peers = {n: n for n in ids}
+    store = IdentityStore(tmp_path, "node0", peers)
+    fabric = LoopbackFabric()
+    party = EDDSAKeygenParty("s-hello", "node0", ids, threshold=1)
+    errs = []
+    done = threading.Event()
+    s = Session(
+        session_id="s-hello",
+        party=party,
+        node_id="node0",
+        participants=ids,
+        transport=fabric.transport(),
+        identity=store,
+        broadcast_topic="t.bcast",
+        direct_topic_fn=lambda n: f"t.direct.{n}",
+        on_error=lambda e: (errs.append(e), done.set()),
+        hello_timeout_s=0.3,
+    )
+    s.listen()  # node1 never says hello
+    assert done.wait(5.0), "deadline did not fire"
+    assert isinstance(errs[0], RetryableSessionError)
+    assert "node1" in str(errs[0])
+    assert s.failed
+    s.close()
+    fabric.close()
+
+
+def test_hello_deadline_cancelled_on_quorum(tmp_path):
+    ids = ["node0", "node1"]
+    for n in ids:
+        generate_identity(n, tmp_path)
+    peers = {n: n for n in ids}
+    fabric = LoopbackFabric()
+    sessions = []
+    errs = []
+    for nid in ids:
+        store = IdentityStore(tmp_path, nid, peers)
+        party = EDDSAKeygenParty("s-ok", nid, ids, threshold=1)
+        s = Session(
+            session_id="s-ok",
+            party=party,
+            node_id=nid,
+            participants=ids,
+            transport=fabric.transport(),
+            identity=store,
+            broadcast_topic="t2.bcast",
+            direct_topic_fn=lambda n: f"t2.direct.{n}",
+            on_error=lambda e: errs.append(e),
+            hello_timeout_s=0.5,
+        )
+        sessions.append(s)
+    for s in sessions:
+        s.listen()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not all(s.done for s in sessions):
+        time.sleep(0.05)
+    assert all(s.done for s in sessions), "keygen did not complete"
+    time.sleep(0.7)  # past the hello deadline: no late spurious failure
+    assert not errs
+    for s in sessions:
+        s.close()
+    fabric.close()

@@ -4,6 +4,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mpcium_tpu.transport.api import Permanent, QueueConfig, TransportError
 from mpcium_tpu.transport.tcp import BrokerServer, tcp_transport
 
@@ -118,3 +120,76 @@ def test_full_cluster_over_tcp(tmp_path):
         )
     finally:
         cluster.close()
+
+
+def test_broker_journal_survives_restart(tmp_path):
+    """File-backed queue durability: a broker restart redelivers every
+    enqueued-but-unacked message (reference JetStream WorkQueue file
+    retention, message_queue.go:56-63)."""
+    journal = str(tmp_path / "queue.jsonl")
+    b1 = BrokerServer(port=0, journal_path=journal)
+    t1 = tcp_transport(b1.host, b1.port)
+    t1.queues.enqueue("mpc.results.a", b"payload-1", idempotency_key="k1")
+    t1.queues.enqueue("mpc.results.b", b"payload-2")
+    time.sleep(0.3)  # let the broker journal the enqueues
+    t1.client.close()
+    b1.close()  # broker dies with no consumer ever attached
+
+    b2 = BrokerServer(port=0, journal_path=journal)
+    t2 = tcp_transport(b2.host, b2.port)
+    got = []
+    evt = threading.Event()
+
+    def handler(data):
+        got.append(data)
+        if len(got) == 2:
+            evt.set()
+
+    sub = t2.queues.dequeue("mpc.results.*", handler)
+    assert evt.wait(10), f"redelivery after restart failed (got {got})"
+    assert sorted(got) == [b"payload-1", b"payload-2"]
+    # acked messages are NOT redelivered by the next restart
+    time.sleep(0.3)
+    sub.unsubscribe()
+    t2.client.close()
+    b2.close()
+    b3 = BrokerServer(port=0, journal_path=journal)
+    t3 = tcp_transport(b3.host, b3.port)
+    got3 = []
+    t3.queues.dequeue("mpc.results.*", got3.append)
+    time.sleep(0.8)
+    assert got3 == []
+    t3.client.close()
+    b3.close()
+
+
+def test_broker_auth(tmp_path):
+    """Token auth: unauthenticated or wrong-token clients are rejected
+    (reference NATS credentials, main.go:346-359)."""
+    b = BrokerServer(port=0, auth_token="s3cret-token")
+    try:
+        # correct token works end-to-end
+        t_ok = tcp_transport(b.host, b.port, auth_token="s3cret-token")
+        got = []
+        evt = threading.Event()
+        t_ok.pubsub.subscribe("x.y", lambda d: (got.append(d), evt.set()))
+        time.sleep(0.2)
+        t_ok.pubsub.publish("x.y", b"hello")
+        assert evt.wait(5)
+
+        # wrong token rejected at connect
+        with pytest.raises(TransportError):
+            tcp_transport(b.host, b.port, auth_token="wrong")
+
+        # tokenless client: frames before auth are ignored/dropped
+        t_no = tcp_transport(b.host, b.port)
+        got2 = []
+        t_no.pubsub.subscribe("x.y", got2.append)
+        time.sleep(0.2)
+        t_ok.pubsub.publish("x.y", b"again")
+        time.sleep(0.5)
+        assert got2 == [], "unauthenticated subscribe must not receive"
+        t_no.client.close()
+        t_ok.client.close()
+    finally:
+        b.close()
