@@ -1,0 +1,13 @@
+# mpcium_tpu node/broker image (reference ships NATS+Consul via compose and
+# installs the Go binaries on the host; here one image serves both roles).
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY mpcium_tpu ./mpcium_tpu
+RUN pip install --no-cache-dir -e . \
+    && pip install --no-cache-dir "jax[cpu]" pyyaml cryptography
+
+# nodes: mpcium-tpu start -n <name>   broker: mpcium-tpu broker
+ENTRYPOINT ["mpcium-tpu"]
+CMD ["--help"]

@@ -1,0 +1,31 @@
+# mpcium_tpu developer entry points (reference Makefile: go install ./cmd/...)
+
+PY ?= python
+
+.PHONY: install test test-all bench broker setup-identities setup-initiator clean
+
+install:
+	pip install -e . --no-build-isolation --no-deps
+
+# smoke tier (< ~1 min target on a laptop core; full crypto suites are slow-marked)
+test:
+	$(PY) -m pytest tests/ -m "not slow" -q
+
+test-all:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+# dev stack: durable broker on :4333 (the docker-compose/nats analogue)
+broker:
+	$(PY) -m mpcium_tpu.cli.main broker --port 4333 --journal ./broker-queue.jsonl
+
+setup-identities:
+	bash scripts/setup_identities.sh
+
+setup-initiator:
+	bash scripts/setup_initiator.sh
+
+clean:
+	rm -rf db control broker-queue.jsonl identity peers.json

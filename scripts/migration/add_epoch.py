@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""One-off data migration: backfill the reshare `epoch` field.
+
+Round-3 introduced epoch fencing for signing sessions (KeygenShare.epoch /
+KeyInfo.epoch). Stores written by earlier builds lack the field; readers
+default it to 0, but a mixed fleet (some nodes re-serializing with epoch,
+some not) is easier to reason about after an explicit backfill — the
+analogue of the reference's scripts/migration/{update-keyinfo,add-key-type}
+(which prefixed legacy records in Consul/Badger).
+
+Usage:
+    python scripts/migration/add_epoch.py --db ./db/node0 \
+        --control ./control --password <badger_password>
+
+Idempotent: records that already carry `epoch` are left untouched.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--db", required=True, help="node share-store directory")
+    ap.add_argument("--control", required=True, help="control-KV (FileKV) root")
+    ap.add_argument("--password", required=True, help="share-store password")
+    args = ap.parse_args()
+
+    from mpcium_tpu.store.kvstore import EncryptedFileKV, FileKV
+
+    migrated = 0
+    kv = EncryptedFileKV(args.db, args.password)
+    for key in kv.keys():
+        if not (key.startswith("ecdsa:") or key.startswith("eddsa:")):
+            continue
+        rec = json.loads(kv.get(key))
+        if "epoch" not in rec:
+            rec["epoch"] = 0
+            kv.put(key, json.dumps(rec).encode())
+            migrated += 1
+
+    ckv = FileKV(args.control)
+    for key in ckv.keys():
+        if not key.startswith("threshold_keyinfo/"):
+            continue
+        rec = json.loads(ckv.get(key))
+        if "epoch" not in rec:
+            rec["epoch"] = 0
+            ckv.put(key, json.dumps(rec).encode())
+            migrated += 1
+
+    print(f"backfilled epoch=0 on {migrated} records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

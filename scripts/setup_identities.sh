@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Bootstrap a 3-node dev cluster's identities (reference setup_identities.sh):
+# peers.json, per-node Ed25519 identities, registration into the control KV.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N="${1:-3}"
+
+mpcium-tpu-cli generate-peers -n "$N"
+mpcium-tpu-cli register-peers
+
+for i in $(seq 0 $((N - 1))); do
+  mpcium-tpu-cli generate-identity --node "node$i" "${ENCRYPT:+--encrypt}"
+done
+
+echo "identities ready: $(ls identity/)"
+echo "next: scripts/setup_initiator.sh, then 'make broker' and per-node"
+echo "      'mpcium-tpu start -n node<i>' (one process per trust domain)"

@@ -8,6 +8,8 @@ import secrets
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mpcium_tpu import wire
 from mpcium_tpu.cluster import LocalCluster, load_test_preparams
 from mpcium_tpu.core import hostmath as hm

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mpcium_tpu.core import bignum as bn
 from mpcium_tpu.core import ed25519_jax as ed
 from mpcium_tpu.core import hostmath as hm

@@ -4,6 +4,8 @@ import secrets
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mpcium_tpu.core import hostmath as hm
 from mpcium_tpu.engine import eddsa_batch as eb
 from mpcium_tpu.engine import sharded
