@@ -1,58 +1,189 @@
 """Throughput benchmark: batched threshold signatures per second on one chip.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Current flagship metric: ed25519 2-of-3 threshold signatures/sec through the
-full 3-round batched protocol (nonce commit+hash-commitment, decommit+
-aggregate, challenge+partials+combine+verify — host hashing included, i.e.
-end-to-end per-party work, not just the device kernels). The north-star
-baseline is 10k sigs/sec (BASELINE.md: secp256k1 2-of-3 on one TPU v5e; the
-reference's own path is sub-second *per* signature, serial). The metric will
-switch to secp256k1 GG18 once the ECDSA engine lands.
+Flagship metric (BASELINE.md north star): batched 2-of-3 **secp256k1 GG18**
+signing at full key size (2048-bit Paillier, default ZK exponent domains)
+through the complete 9-round protocol — MtA with range proofs, phase-5
+commit–reveal, final in-protocol ECDSA verification — with all hashing and
+bignum work on device (engine.gg18_batch on ops.modmul MXU kernels).
+
+Robust to backend flake (the round-2 lesson): the TPU backend is probed in
+a SUBPROCESS with a timeout (a wedged axon relay hangs `import jax`
+forever); on persistent failure the bench re-execs itself pinned to CPU
+and still emits the JSON line with "platform": "cpu" — a degraded number
+beats rc=1.
+
+Env knobs: MPCIUM_BENCH_B (batch, default 1024), MPCIUM_BENCH_RUNS
+(timed runs, default 1), MPCIUM_BENCH_FULL=1 (also report the ed25519
+signing / batched DKG / batched resharing secondary metrics).
 """
 from __future__ import annotations
 
 import json
+import os
 import secrets
+import subprocess
+import sys
 import time
 
-import numpy as np
-
 BASELINE_SIGS_PER_SEC = 10_000.0
+_PROBE = "import jax; d = jax.devices(); assert d[0].platform != 'cpu'"
+
+
+def _probe_tpu(attempts: int = 3, timeout_s: int = 120) -> bool:
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if i + 1 < attempts:
+            time.sleep(15 * (i + 1))
+    return False
+
+
+def _ensure_backend() -> str:
+    """Probe the TPU; on failure re-exec pinned to CPU (the axon
+    sitecustomize must be stripped from PYTHONPATH or a wedged relay hangs
+    the import itself). Returns the platform this process will use."""
+    if os.environ.get("MPCIUM_BENCH_CHILD"):
+        return os.environ.get("MPCIUM_BENCH_PLATFORM", "cpu")
+    if _probe_tpu():
+        os.environ["MPCIUM_BENCH_CHILD"] = "1"
+        os.environ["MPCIUM_BENCH_PLATFORM"] = "tpu"
+        return "tpu"
+    env = dict(os.environ)
+    env["MPCIUM_BENCH_CHILD"] = "1"
+    env["MPCIUM_BENCH_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if p and "axon" not in p
+    )
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    raise RuntimeError("unreachable")
 
 
 def main() -> None:
-    from mpcium_tpu.engine import eddsa_batch as eb
+    platform = _ensure_backend()
+    B = int(os.environ.get("MPCIUM_BENCH_B", "1024"))
+    runs = int(os.environ.get("MPCIUM_BENCH_RUNS", "1"))
 
-    B = 4096
-    q, t = 2, 1
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", 
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import numpy as np
+
+    from mpcium_tpu.cluster import load_test_preparams
+    from mpcium_tpu.engine import gg18_batch as gb
+
     party_ids = ["node0", "node1", "node2"]
-    shares = eb.dealer_keygen_batch(B, party_ids, t, rng=secrets)
-    signer = eb.BatchedCoSigners(party_ids[:q], shares[:q], rng=secrets)
-    messages = [secrets.token_bytes(32) for _ in range(B)]
+    t0 = time.perf_counter()
+    shares = gb.dealer_keygen_secp_batch(B, party_ids, threshold=1)
+    preparams = load_test_preparams()
+    signer = gb.GG18BatchCoSigners(
+        party_ids[:2], shares[:2], preparams, rng=secrets
+    )
+    setup_s = time.perf_counter() - t0
+    digests = np.frombuffer(
+        secrets.token_bytes(B * 32), dtype=np.uint8
+    ).reshape(B, 32)
 
-    # warmup: compile all kernels at this batch size
-    sigs, ok = signer.sign(messages)
-    assert ok.all(), "warmup signatures invalid"
+    # warmup: compile every kernel at this batch size
+    t0 = time.perf_counter()
+    out = signer.sign(digests)
+    compile_s = time.perf_counter() - t0
+    assert out["ok"].all(), "warmup GG18 signatures invalid"
 
-    runs = 3
-    start = time.perf_counter()
+    # one phase-profiled run (sync at phase boundaries)
+    phases: dict = {}
+    t0 = time.perf_counter()
+    out = signer.sign(digests, phase_times=phases)
+    profiled_s = time.perf_counter() - t0
+    assert out["ok"].all()
+
+    # timed runs (no internal sync)
+    t0 = time.perf_counter()
     for _ in range(runs):
-        sigs, ok = signer.sign(messages)
-        assert ok.all()
-    elapsed = time.perf_counter() - start
+        out = signer.sign(digests)
+        assert out["ok"].all()
+    elapsed = time.perf_counter() - t0
 
     sigs_per_sec = runs * B / elapsed
+    extra = {}
+    if os.environ.get("MPCIUM_BENCH_FULL"):
+        extra = _secondary_metrics(B)
     print(
         json.dumps(
             {
-                "metric": "ed25519_2of3_threshold_sigs_per_sec",
+                "metric": "secp256k1_2of3_gg18_sigs_per_sec",
                 "value": round(sigs_per_sec, 1),
                 "unit": "signatures/sec",
-                "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 3),
+                "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 4),
+                "platform": platform,
+                "batch": B,
+                "runs": runs,
+                "setup_s": round(setup_s, 1),
+                "compile_s": round(compile_s, 1),
+                "profiled_run_s": round(profiled_s, 1),
+                "phase_s": {k: round(v, 2) for k, v in phases.items()},
+                **extra,
             }
         )
     )
+
+
+def _secondary_metrics(B: int) -> dict:
+    """BASELINE configs 2/4/5: ed25519 signing, batched DKG, batched
+    resharing throughputs (MPCIUM_BENCH_FULL=1)."""
+    import secrets as sec
+
+    from mpcium_tpu.engine import eddsa_batch as eb
+    from mpcium_tpu.engine.dkg_batch import BatchedDKG, BatchedReshare
+
+    out = {}
+    ids = ["node0", "node1", "node2"]
+
+    shares = eb.dealer_keygen_batch(B, ids, 1, rng=sec)
+    signer = eb.BatchedCoSigners(ids[:2], shares[:2], rng=sec)
+    messages = [sec.token_bytes(32) for _ in range(B)]
+    sigs, ok = signer.sign(messages)  # warmup/compile
+    assert ok.all()
+    t0 = time.perf_counter()
+    sigs, ok = signer.sign(messages)
+    out["ed25519_2of3_sigs_per_sec"] = round(
+        B / (time.perf_counter() - t0), 1
+    )
+
+    dkg = BatchedDKG(ids, threshold=1, key_type="secp256k1", rng=sec)
+    dkg.run(min(B, 64))  # warmup/compile at a smaller shape
+    t0 = time.perf_counter()
+    dshares = dkg.run(B)
+    out["secp256k1_dkg_wallets_per_sec"] = round(
+        B / (time.perf_counter() - t0), 1
+    )
+
+    Br = max(B // 4, 1)
+    rs = BatchedReshare(
+        ids[:2], [dshares[0][:Br], dshares[1][:Br]],
+        ["node0", "node1", "node2", "node3", "node4"], new_threshold=2,
+        rng=sec,
+    )
+    t0 = time.perf_counter()
+    rs.run()
+    out["reshare_2of3_to_3of5_wallets_per_sec"] = round(
+        Br / (time.perf_counter() - t0), 1
+    )
+    return out
 
 
 if __name__ == "__main__":

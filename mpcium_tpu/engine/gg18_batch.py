@@ -7,10 +7,16 @@ dispatches per party. The protocol is mathematically identical to
 commit–reveal) — re-expressed over limb tensors:
 
 - curve ops ride :mod:`core.secp256k1_jax` (12-bit limb family);
-- Paillier / ring-Pedersen modexps ride :mod:`core.bignum` Barrett contexts
-  in the 11-bit limb family (block-structured wide muls);
-- hashing (commitments, Fiat–Shamir challenges) stays host-side over
-  fixed-width byte serializations pulled from device.
+- Paillier / ring-Pedersen arithmetic rides :mod:`ops.modmul` (7-bit limb
+  family: MXU Toeplitz constant-muls, lookahead carries) via
+  :mod:`ops.paillier_mxu` (short-randomizer encryption, CRT decryption);
+- hashing (commitments, Fiat–Shamir challenges) runs ON DEVICE
+  (:mod:`ops.sha256`) over fixed-width byte serializations — no host
+  round-trips inside the protocol (the host orchestrates dispatches only).
+
+Quorum size is generic: ``party_ids`` may list any t+1-of-n quorum
+(reference signs with any quorum ≥ t+1, ecdsa_signing_session.go:96-139);
+MtA runs over all ordered pairs.
 
 Transcript note: the batched fabric hashes fixed-width byte encodings (not
 the per-session host protocol's length-prefixed ints) — the two paths are
@@ -19,19 +25,20 @@ separate wire universes; parity with the reference is at the result level
 
 Randomness policy: a value mod M is sampled as CSPRNG bits of
 ``bits(M) - 8`` (for masks, where slight undersampling only strengthens the
-bound) or reduced mod M on device; Paillier randomizers skip the
-gcd(r, N) = 1 rejection (a non-unit hit implies factoring N).
+bound) or reduced mod M on device. Paillier randomizers are y^u for
+256-bit u (ops.paillier_mxu short-randomizer encryption — DCR + standard
+short-exponent assumption).
 
 Test note: proof-equation algebra holds for any key size, so unit tests run
 512-bit keys with shrunk exponent domains (the ``bits`` knobs below); the
-full-size path is exercised by bench.py on real hardware.
+full-size path is exercised by bench.py and the slow-marked
+test_gg18_full_size.
 """
 from __future__ import annotations
 
 import functools
-import hashlib
 import secrets
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -42,7 +49,11 @@ from ..core import bignum as bn
 from ..core import hostmath as hm
 from ..core import secp256k1_jax as sp
 from ..core.bignum import P256
-from ..core.paillier import PaillierBatch, PreParams
+from ..core.fields import secp256k1_field
+from ..core.paillier import PreParams
+from ..ops import modmul as mm
+from ..ops.paillier_mxu import RAND_BITS, PaillierMXUPrivate
+from ..ops.sha256 import sha256 as dev_sha256
 from ..protocol.base import KeygenShare, party_xs
 
 Q = hm.SECP_N
@@ -64,8 +75,10 @@ class Domains:
         return Q**3
 
 
-def _prof11(bits: int) -> bn.LimbProfile:
-    return bn.LimbProfile(bits=11, n_limbs=max(2, -(-bits // 11)))
+def _prof7(bits: int) -> bn.LimbProfile:
+    """Unpadded 7-bit profile (proof-domain integers; widths stay exact so
+    serializations are minimal)."""
+    return bn.LimbProfile(bits=7, n_limbs=max(2, -(-bits // 7)))
 
 
 def rand_bits(batch: int, bits: int, rng=secrets) -> np.ndarray:
@@ -79,31 +92,50 @@ def rand_bits(batch: int, bits: int, rng=secrets) -> np.ndarray:
     return out
 
 
-def hash_rows(tag: bytes, *parts) -> np.ndarray:
-    """Per-session SHA-256 over concatenated fixed-width rows → (B, 32)."""
-    parts = [np.asarray(p) for p in parts]
-    B = parts[0].shape[0]
-    out = np.empty((B, 32), dtype=np.uint8)
-    for i in range(B):
-        h = hashlib.sha256(b"mpcium-tpu/gg18-batch/" + tag)
-        for p in parts:
-            h.update(p[i].tobytes())
-        out[i] = np.frombuffer(h.digest(), dtype=np.uint8)
-    return out
+def rand_bit_tensor(batch: int, bits: int, rng=secrets) -> jnp.ndarray:
+    """(B, bits) int32 uniform CSPRNG bits, LSB-first per value."""
+    by = rand_bits(batch, bits, rng)
+    arr = np.unpackbits(by, axis=-1, bitorder="little")[:, :bits]
+    return jnp.asarray(arr.astype(np.int32))
 
 
-def _int_mul_add(e, m, add, prof) -> jnp.ndarray:
-    """e·m + add over plain integers (no modulus), normalized to the width
-    of `prof`."""
-    prod = bn.mul_wide(e, m, prof)
-    width = prof.n_limbs
-    return bn.carry(
-        bn.take_limbs(prod, 0, width) + bn.take_limbs(add, 0, width), prof
-    )
+def dev_hash(tag: bytes, *rows) -> jnp.ndarray:
+    """Batched SHA-256 on device over tag ‖ fixed-width rows → (B, 32)."""
+    rows = [jnp.asarray(r).astype(jnp.uint8) for r in rows]
+    B = rows[0].shape[0]
+    t = np.frombuffer(b"mpcium-tpu/gg18-batch/" + tag, dtype=np.uint8)
+    tag_t = jnp.broadcast_to(jnp.asarray(t), (B, t.shape[0]))
+    return dev_sha256(jnp.concatenate([tag_t] + rows, axis=-1))
+
+
+def bytes_to_bits(b: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """(..., nB) uint8 little-endian → (..., n_bits) int32 bits LSB-first."""
+    bits = (b[..., :, None].astype(jnp.int32) >> jnp.arange(8)) & 1
+    bits = bits.reshape(b.shape[:-1] + (b.shape[-1] * 8,))
+    if bits.shape[-1] < n_bits:
+        return jnp.pad(
+            bits, [(0, 0)] * (bits.ndim - 1) + [(0, n_bits - bits.shape[-1])]
+        )
+    return bits[..., :n_bits]
 
 
 def _bits_of(x: jnp.ndarray, prof: bn.LimbProfile, n_bits: int) -> jnp.ndarray:
     return bn.limbs_to_bits(x, prof, n_bits)
+
+
+@functools.partial(jax.jit, static_argnums=3)
+def _int_mul_add(e, m, add, prof) -> jnp.ndarray:
+    """e·m + add over plain integers (no modulus), normalized to the width
+    of `prof`. Inputs normalized 7-bit limbs."""
+    prod = mm.mul_pair(e, m)
+    width = prof.n_limbs
+    return mm.carry(
+        bn.take_limbs(prod, 0, width) + bn.take_limbs(add, 0, width)
+    )
+
+
+def _eq_all(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -114,14 +146,13 @@ def _bits_of(x: jnp.ndarray, prof: bn.LimbProfile, n_bits: int) -> jnp.ndarray:
 class PartyCtx:
     """One signer's static crypto material + device contexts."""
 
-    def __init__(self, pid: str, pre: PreParams):
+    def __init__(self, pid: str, pre: PreParams, rng=secrets):
         self.pid = pid
         self.pre = pre
-        self.pb = PaillierBatch(pre.paillier.public)
+        self.pmx = PaillierMXUPrivate(pre.paillier, rng=rng)
         self.N = pre.paillier.N
         self.NTilde = pre.NTilde
-        self.prof_nt = _prof11(self.NTilde.bit_length())
-        self.ctx_nt = bn.BarrettCtx(self.NTilde, self.prof_nt)
+        self.ctx_nt = mm.MXUBarrett(self.NTilde)
         self.h1 = pre.h1
         self.h2 = pre.h2
         self.nt_bytes = -(-self.NTilde.bit_length() // 8)
@@ -129,20 +160,16 @@ class PartyCtx:
         self.n_bytes = -(-self.N.bit_length() // 8)
 
     def commit_ring(self, m_bits: jnp.ndarray, r_bits: jnp.ndarray) -> jnp.ndarray:
-        """h1^m · h2^r mod NTilde — two fixed-base table modexps."""
+        """h1^m · h2^r mod NTilde — two comb-table fixed-base exps."""
         a = self.ctx_nt.powmod_fixed_base(self.h1, m_bits)
         b = self.ctx_nt.powmod_fixed_base(self.h2, r_bits)
         return self.ctx_nt.mulmod(a, b)
 
+    def nt_row(self, x: jnp.ndarray) -> jnp.ndarray:
+        return bn.limbs_to_bytes_le(x, self.ctx_nt.prof, self.nt_bytes)
 
-def _enc_deterministic(pb: PaillierBatch, m_limbs) -> jnp.ndarray:
-    """(1 + m·N) mod N² for m < N — the deterministic Paillier leg."""
-    N_l = jnp.broadcast_to(
-        jnp.asarray(pb.N_limbs), m_limbs.shape[:-1] + (pb.prof_n.n_limbs,)
-    )
-    mN = bn.mul_wide(m_limbs, N_l, pb.prof_n2)
-    out = bn.take_limbs(mN, 0, pb.prof_n2.n_limbs).at[..., 0].add(1)
-    return bn.carry(out, pb.prof_n2)
+    def n2_row(self, x: jnp.ndarray) -> jnp.ndarray:
+        return bn.limbs_to_bytes_le(x, self.pmx.prof_n2, self.n2_bytes)
 
 
 # ---------------------------------------------------------------------------
@@ -153,9 +180,9 @@ def _enc_deterministic(pb: PaillierBatch, m_limbs) -> jnp.ndarray:
 class MtaBatch:
     """Batched MtA + proofs for the ordered pair (alice, bob).
 
-    The flow mirrors protocol.ecdsa.{mta,zk} exactly; the caller drives the
-    host Fiat–Shamir points between device steps. State dicts hold limb
-    tensors; every function is shape-stable and jit-compiled on first use.
+    The flow mirrors protocol.ecdsa.{mta,zk} exactly, over MXU limb
+    tensors with device-side Fiat–Shamir. State dicts hold limb tensors;
+    every heavy call runs through jitted kernels.
     """
 
     def __init__(self, alice: PartyCtx, bob: PartyCtx, dom: Domains = Domains()):
@@ -163,33 +190,21 @@ class MtaBatch:
         self.bob = bob
         self.dom = dom
         d = dom
-        self.p_e = _prof11(d.scalar)
-        self.p_alpha = _prof11(d.alpha)
-        self.p_s1 = _prof11(d.scalar + d.alpha + 11)
+        self.p_e = _prof7(d.scalar)
+        self.p_alpha = _prof7(d.alpha)
+        self.p_s1 = _prof7(d.scalar + d.alpha + 7)
         nt_bits = bob.NTilde.bit_length()
         nt_bits_a = alice.NTilde.bit_length()
-        self.p_rho = _prof11(d.scalar + max(nt_bits, nt_bits_a) + d.rho_extra)
-        self.p_s2 = _prof11(d.scalar + self.p_rho.n_limbs * 11 + 11)
-        self.p_bp = _prof11(d.beta_prime)
-        self.p_gb = _prof11(d.gamma_bob)
-        self.p_t1 = _prof11(d.scalar + d.gamma_bob + 11)
+        self.p_rho = _prof7(d.scalar + max(nt_bits, nt_bits_a) + d.rho_extra)
+        self.p_s2 = _prof7(d.scalar + self.p_rho.n_limbs * 7 + 7)
+        self.p_bp = _prof7(d.beta_prime)
+        self.p_gb = _prof7(d.gamma_bob)
+        self.p_t1 = _prof7(d.scalar + d.gamma_bob + 7)
 
-    # -- randomness bundles (host) ------------------------------------------
-
-    def _unit_mod_NA(self, B: int, rng) -> jnp.ndarray:
-        """Paillier randomizer mod N_A: (bits(N)+64)-bit sample reduced on
-        device (bias 2^-64; unit whp)."""
-        A = self.alice
-        nb = A.N.bit_length()
-        return A.pb.ctx_N.reduce(
-            bn.bytes_to_limbs_le(
-                jnp.asarray(rand_bits(B, nb + 64, rng)),
-                A.pb.prof_n, 2 * A.pb.prof_n.n_limbs,
-            )
-        )
+    # -- randomness bundles (host CSPRNG → device) --------------------------
 
     @staticmethod
-    def _dom_bits(B, bits, prof, rng):
+    def _dom_limbs(B, bits, prof, rng):
         return bn.bytes_to_limbs_le(
             jnp.asarray(rand_bits(B, bits, rng)), prof, prof.n_limbs
         )
@@ -198,174 +213,209 @@ class MtaBatch:
         d = self.dom
         nt_b = self.bob.NTilde.bit_length()
         return {
-            "r": self._unit_mod_NA(B, rng),
-            "alpha": self._dom_bits(B, d.alpha - 8, self.p_alpha, rng),
-            "rho": self._dom_bits(B, d.scalar + nt_b - 8, self.p_rho, rng),
-            "gamma": self._dom_bits(B, d.alpha + nt_b - 8, self.p_s2, rng),
-            "beta_r": self._unit_mod_NA(B, rng),
+            "u_enc": rand_bit_tensor(B, RAND_BITS, rng),  # Enc(α) randomizer
+            "alpha": self._dom_limbs(B, d.alpha - 8, self.p_alpha, rng),
+            "rho": self._dom_limbs(B, d.scalar + nt_b - 8, self.p_rho, rng),
+            "gamma": self._dom_limbs(B, d.alpha + nt_b - 8, self.p_s2, rng),
         }
 
     def bob_randoms(self, B: int, rng=secrets) -> Dict[str, jnp.ndarray]:
         d = self.dom
         nt_a = self.alice.NTilde.bit_length()
         return {
-            "beta_prime": self._dom_bits(B, d.beta_prime - 8, self.p_bp, rng),
-            "r": self._unit_mod_NA(B, rng),
-            "alpha": self._dom_bits(B, d.alpha - 8, self.p_alpha, rng),
-            "rho": self._dom_bits(B, d.scalar + nt_a - 8, self.p_rho, rng),
-            "rho_p": self._dom_bits(B, d.alpha + nt_a - 8, self.p_s2, rng),
-            "sigma": self._dom_bits(B, d.scalar + nt_a - 8, self.p_rho, rng),
-            "tau": self._dom_bits(B, d.alpha + nt_a - 8, self.p_s2, rng),
-            "beta_r": self._unit_mod_NA(B, rng),
-            "gamma": self._dom_bits(B, d.gamma_bob - 8, self.p_gb, rng),
+            "beta_prime": self._dom_limbs(B, d.beta_prime - 8, self.p_bp, rng),
+            "u_bp": rand_bit_tensor(B, RAND_BITS, rng),  # Enc(β′) randomizer
+            "alpha": self._dom_limbs(B, d.alpha - 8, self.p_alpha, rng),
+            "rho": self._dom_limbs(B, d.scalar + nt_a - 8, self.p_rho, rng),
+            "rho_p": self._dom_limbs(B, d.alpha + nt_a - 8, self.p_s2, rng),
+            "sigma": self._dom_limbs(B, d.scalar + nt_a - 8, self.p_rho, rng),
+            "tau": self._dom_limbs(B, d.alpha + nt_a - 8, self.p_s2, rng),
+            "u_g": rand_bit_tensor(B, RAND_BITS, rng),  # Enc(γ) randomizer
+            "gamma": self._dom_limbs(B, d.gamma_bob - 8, self.p_gb, rng),
         }
 
-    # -- Alice: encrypt + range proof ---------------------------------------
+    # -- Alice: range proof for c_a = Enc_A(m; y^u) -------------------------
 
     def alice_init(self, m_limbs, R: Dict[str, jnp.ndarray]):
-        """m: plaintext (< q) as Alice-N plaintext limbs. Returns the
-        pre-challenge transcript {c_a, z, u, w}."""
+        """m: plaintext (< q) in Alice's prof_n. Returns the pre-challenge
+        transcript {z, u, w} (c_a itself is per-party, passed separately).
+        """
         A, Bo = self.alice, self.bob
-        c_a = A.pb.encrypt(m_limbs, R["r"])
         z = Bo.commit_ring(
-            _bits_of(m_limbs, A.pb.prof_n, self.dom.scalar),
-            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 11),
+            _bits_of(m_limbs, A.pmx.prof_n, self.dom.scalar),
+            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 7),
         )
-        u = A.pb.encrypt(
-            bn.take_limbs(R["alpha"], 0, A.pb.prof_n.n_limbs), R["beta_r"]
+        u_c, _u_r = A.pmx.encrypt(
+            bn.take_limbs(R["alpha"], 0, A.pmx.prof_n.n_limbs), R["u_enc"]
         )
         w = Bo.commit_ring(
             _bits_of(R["alpha"], self.p_alpha, self.dom.alpha),
-            _bits_of(R["gamma"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(R["gamma"], self.p_s2, self.p_s2.n_limbs * 7),
         )
-        return {"c_a": c_a, "z": z, "u": u, "w": w}
+        return {"z": z, "u": u_c, "w": w}
 
-    def alice_challenge(self, T) -> np.ndarray:
-        """Fiat–Shamir e ← H(transcript) (host)."""
+    def alice_challenge(self, c_a, T) -> jnp.ndarray:
         A, Bo = self.alice, self.bob
-        return hash_rows(
+        return dev_hash(
             b"alice",
-            bn.limbs_to_bytes_le(T["c_a"], A.pb.prof_n2, A.n2_bytes),
-            bn.limbs_to_bytes_le(T["z"], Bo.prof_nt, Bo.nt_bytes),
-            bn.limbs_to_bytes_le(T["u"], A.pb.prof_n2, A.n2_bytes),
-            bn.limbs_to_bytes_le(T["w"], Bo.prof_nt, Bo.nt_bytes),
+            A.n2_row(c_a),
+            Bo.nt_row(T["z"]),
+            A.n2_row(T["u"]),
+            Bo.nt_row(T["w"]),
         )
 
-    def e_limbs(self, e32: np.ndarray) -> jnp.ndarray:
-        return bn.bytes_to_limbs_le(jnp.asarray(e32), self.p_e, self.p_e.n_limbs)
+    def e_limbs(self, e32: jnp.ndarray) -> jnp.ndarray:
+        return bn.bytes_to_limbs_le(
+            jnp.asarray(e32), self.p_e, self.p_e.n_limbs
+        )
 
-    def alice_finish(self, e, m_limbs, R):
-        """Challenge responses: s = r^e·β mod N_A; s1 = e·m + α;
-        s2 = e·ρ + γ."""
+    def alice_finish(self, e, m_limbs, R, u_ca_bits):
+        """Responses: s = y^(u_ca·e + u_enc) mod N (the randomizer leg,
+        all in the exponent thanks to short-randomizer encryption);
+        s1 = e·m + α; s2 = e·ρ + γ.
+
+        ``u_ca_bits``: the 256-bit exponent that produced c_a's randomizer
+        (r = y^u_ca)."""
         A = self.alice
-        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
-        s = A.pb.ctx_N.mulmod(A.pb.ctx_N.powmod(R["r"], e_bits), R["beta_r"])
+        p_u = _prof7(RAND_BITS)
+        u_ca = _bits_pack(u_ca_bits, p_u)
+        u_enc = _bits_pack(R["u_enc"], p_u)
+        prod = mm.mul_pair(u_ca, self.e_limbs_from(e))  # 512-bit integer
+        p_E = _prof7(2 * RAND_BITS + 8)
+        E = mm.carry(
+            bn.take_limbs(prod, 0, p_E.n_limbs)
+            + bn.take_limbs(u_enc, 0, p_E.n_limbs)
+        )
+        s = A.pmx.ctx_N.powmod_fixed_base(
+            A.pmx.y % A.N, _bits_of(E, p_E, p_E.n_limbs * 7)
+        )
         m_e = bn.take_limbs(m_limbs, 0, self.p_e.n_limbs)
+        e_l = self.e_limbs_from(e)
         s1 = _int_mul_add(
-            e, m_e, bn.take_limbs(R["alpha"], 0, self.p_s1.n_limbs), self.p_s1
+            e_l, m_e, bn.take_limbs(R["alpha"], 0, self.p_s1.n_limbs), self.p_s1
         )
         s2 = _int_mul_add(
-            e, R["rho"], bn.take_limbs(R["gamma"], 0, self.p_s2.n_limbs), self.p_s2
+            e_l, R["rho"], bn.take_limbs(R["gamma"], 0, self.p_s2.n_limbs),
+            self.p_s2,
         )
         return {"s": s, "s1": s1, "s2": s2}
 
-    def bob_check_alice(self, T, P, e) -> jnp.ndarray:
+    def e_limbs_from(self, e) -> jnp.ndarray:
+        """Accept either raw (B, 32) digest bytes or already-packed limbs."""
+        if e.shape[-1] == 32 and e.dtype == jnp.uint8:
+            return self.e_limbs(e)
+        return e
+
+    def bob_check_alice(self, c_a, T, P, e) -> jnp.ndarray:
         """Batched Alice-proof verification → (B,) bool."""
         A, Bo = self.alice, self.bob
+        e_l = self.e_limbs_from(e)
         q3 = jnp.broadcast_to(
             jnp.asarray(bn.to_limbs(self.dom.q3(), self.p_s1)), P["s1"].shape
         )
         ok = bn.compare(P["s1"], q3) <= 0
-        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
-        n2 = A.pb.ctx_N2
-        s1_modN = A.pb.ctx_N.reduce(
-            bn.take_limbs(P["s1"], 0, 2 * A.pb.prof_n.n_limbs)
+        e_bits = _bits_of(e_l, self.p_e, self.dom.scalar)
+        n2 = A.pmx.ctx_N2
+        s1_modN = A.pmx.ctx_N.reduce(
+            bn.take_limbs(P["s1"], 0, min(P["s1"].shape[-1], 2 * A.pmx.prof_n.n_limbs))
         )
         lhs = n2.mulmod(
-            _enc_deterministic(A.pb, s1_modN),
-            n2.powmod_const(
+            A.pmx.enc_deterministic(s1_modN),
+            n2.powmod_const_exp(
                 bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N
             ),
         )
-        rhs = n2.mulmod(T["u"], n2.powmod(T["c_a"], e_bits))
-        ok = ok & jnp.all(lhs == rhs, axis=-1)
+        rhs = n2.mulmod(T["u"], n2.powmod(c_a, e_bits))
+        ok = ok & _eq_all(lhs, rhs)
         lhs2 = Bo.commit_ring(
-            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11),
-            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7),
+            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         rhs2 = Bo.ctx_nt.mulmod(T["w"], Bo.ctx_nt.powmod(T["z"], e_bits))
-        return ok & jnp.all(lhs2 == rhs2, axis=-1)
+        return ok & _eq_all(lhs2, rhs2)
 
     # -- Bob: homomorphic response + proof ----------------------------------
 
-    def bob_respond(self, c_a, b_limbs, R, with_check: bool):
-        """c_b = c_a^b · Enc_A(β′); pre-challenge proof transcript.
-        ``b_limbs``: Bob's secret (< q) in the 11-bit e-profile.
-        with_check adds U = α·G for the curve binding (computed by caller
-        in the 12-bit curve family)."""
+    def bob_respond(self, c_a, b_limbs, R):
+        """c_b = c_a^b · Enc_A(β′; y^u_bp); pre-challenge proof transcript.
+        ``b_limbs``: Bob's secret (< q) in the 7-bit e-profile."""
         A = self.alice
         b_bits = _bits_of(b_limbs, self.p_e, self.dom.scalar)
-        c_b = A.pb.ctx_N2.mulmod(
-            A.pb.ctx_N2.powmod(c_a, b_bits),
-            A.pb.encrypt(
-                bn.take_limbs(R["beta_prime"], 0, A.pb.prof_n.n_limbs), R["r"]
-            ),
+        enc_bp, _r = A.pmx.encrypt(
+            bn.take_limbs(R["beta_prime"], 0, A.pmx.prof_n.n_limbs), R["u_bp"]
         )
+        c_b = A.pmx.ctx_N2.mulmod(A.pmx.ctx_N2.powmod(c_a, b_bits), enc_bp)
         z = A.commit_ring(
             _bits_of(b_limbs, self.p_e, self.dom.scalar),
-            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 11),
+            _bits_of(R["rho"], self.p_rho, self.p_rho.n_limbs * 7),
         )
         z_p = A.commit_ring(
             _bits_of(R["alpha"], self.p_alpha, self.dom.alpha),
-            _bits_of(R["rho_p"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(R["rho_p"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         t = A.commit_ring(
             _bits_of(R["beta_prime"], self.p_bp, self.dom.beta_prime),
-            _bits_of(R["sigma"], self.p_rho, self.p_rho.n_limbs * 11),
+            _bits_of(R["sigma"], self.p_rho, self.p_rho.n_limbs * 7),
         )
-        v = A.pb.ctx_N2.mulmod(
-            A.pb.ctx_N2.powmod(c_a, _bits_of(R["alpha"], self.p_alpha, self.dom.alpha)),
-            A.pb.encrypt(
-                bn.take_limbs(R["gamma"], 0, A.pb.prof_n.n_limbs), R["beta_r"]
+        enc_g, _r2 = A.pmx.encrypt(
+            bn.take_limbs(R["gamma"], 0, A.pmx.prof_n.n_limbs), R["u_g"]
+        )
+        v = A.pmx.ctx_N2.mulmod(
+            A.pmx.ctx_N2.powmod(
+                c_a, _bits_of(R["alpha"], self.p_alpha, self.dom.alpha)
             ),
+            enc_g,
         )
         w = A.commit_ring(
             _bits_of(R["gamma"], self.p_gb, self.dom.gamma_bob),
-            _bits_of(R["tau"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(R["tau"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         return {"c_b": c_b, "z": z, "z_p": z_p, "t": t, "v": v, "w": w}
 
-    def bob_challenge(self, c_a, T, extra_rows: Sequence[np.ndarray] = ()) -> np.ndarray:
+    def bob_challenge(self, c_a, T, extra_rows: Sequence = ()) -> jnp.ndarray:
         A = self.alice
         rows = [
-            bn.limbs_to_bytes_le(c_a, A.pb.prof_n2, A.n2_bytes),
-            bn.limbs_to_bytes_le(T["c_b"], A.pb.prof_n2, A.n2_bytes),
-            bn.limbs_to_bytes_le(T["z"], A.prof_nt, A.nt_bytes),
-            bn.limbs_to_bytes_le(T["z_p"], A.prof_nt, A.nt_bytes),
-            bn.limbs_to_bytes_le(T["t"], A.prof_nt, A.nt_bytes),
-            bn.limbs_to_bytes_le(T["v"], A.pb.prof_n2, A.n2_bytes),
-            bn.limbs_to_bytes_le(T["w"], A.prof_nt, A.nt_bytes),
+            A.n2_row(c_a),
+            A.n2_row(T["c_b"]),
+            A.nt_row(T["z"]),
+            A.nt_row(T["z_p"]),
+            A.nt_row(T["t"]),
+            A.n2_row(T["v"]),
+            A.nt_row(T["w"]),
         ]
         rows.extend(extra_rows)
-        return hash_rows(b"bob", *rows)
+        return dev_hash(b"bob", *rows)
 
     def bob_finish(self, e, b_limbs, R):
-        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
         A = self.alice
-        s = A.pb.ctx_N.mulmod(A.pb.ctx_N.powmod(R["r"], e_bits), R["beta_r"])
+        e_l = self.e_limbs_from(e)
+        p_u = _prof7(RAND_BITS)
+        u_bp = _bits_pack(R["u_bp"], p_u)
+        u_g = _bits_pack(R["u_g"], p_u)
+        prod = mm.mul_pair(u_bp, e_l)
+        p_E = _prof7(2 * RAND_BITS + 8)
+        E = mm.carry(
+            bn.take_limbs(prod, 0, p_E.n_limbs)
+            + bn.take_limbs(u_g, 0, p_E.n_limbs)
+        )
+        s = A.pmx.ctx_N.powmod_fixed_base(
+            A.pmx.y % A.N, _bits_of(E, p_E, p_E.n_limbs * 7)
+        )
         s1 = _int_mul_add(
-            e, bn.take_limbs(b_limbs, 0, self.p_e.n_limbs),
+            e_l, bn.take_limbs(b_limbs, 0, self.p_e.n_limbs),
             bn.take_limbs(R["alpha"], 0, self.p_s1.n_limbs), self.p_s1,
         )
         s2 = _int_mul_add(
-            e, R["rho"], bn.take_limbs(R["rho_p"], 0, self.p_s2.n_limbs), self.p_s2
+            e_l, R["rho"], bn.take_limbs(R["rho_p"], 0, self.p_s2.n_limbs),
+            self.p_s2,
         )
         t1 = _int_mul_add(
-            e, bn.take_limbs(R["beta_prime"], 0, self.p_t1.n_limbs),
+            e_l, bn.take_limbs(R["beta_prime"], 0, self.p_t1.n_limbs),
             bn.take_limbs(R["gamma"], 0, self.p_t1.n_limbs), self.p_t1,
         )
         t2 = _int_mul_add(
-            e, R["sigma"], bn.take_limbs(R["tau"], 0, self.p_s2.n_limbs), self.p_s2
+            e_l, R["sigma"], bn.take_limbs(R["tau"], 0, self.p_s2.n_limbs),
+            self.p_s2,
         )
         return {"s": s, "s1": s1, "s2": s2, "t1": t1, "t2": t2}
 
@@ -373,50 +423,67 @@ class MtaBatch:
         """Batched Bob-proof verification (ciphertext + ring legs; the
         with-check curve leg is checked by the caller)."""
         A = self.alice
+        e_l = self.e_limbs_from(e)
         q3 = jnp.broadcast_to(
             jnp.asarray(bn.to_limbs(self.dom.q3(), self.p_s1)), P["s1"].shape
         )
         ok = bn.compare(P["s1"], q3) <= 0
-        # q⁷ bound; in shrunk test domains the profile capacity caps it
-        # (honest t1 always fits the profile by construction)
         t1_cap = (1 << (self.p_t1.bits * self.p_t1.n_limbs)) - 1
         q7 = jnp.broadcast_to(
             jnp.asarray(bn.to_limbs(min(Q**7, t1_cap), self.p_t1)),
             P["t1"].shape,
         )
         ok = ok & (bn.compare(P["t1"], q7) <= 0)
-        e_bits = _bits_of(e, self.p_e, self.dom.scalar)
+        e_bits = _bits_of(e_l, self.p_e, self.dom.scalar)
         lhs = A.commit_ring(
-            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11),
-            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7),
+            _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         rhs = A.ctx_nt.mulmod(T["z_p"], A.ctx_nt.powmod(T["z"], e_bits))
-        ok = ok & jnp.all(lhs == rhs, axis=-1)
+        ok = ok & _eq_all(lhs, rhs)
         lhs = A.commit_ring(
-            _bits_of(P["t1"], self.p_t1, self.p_t1.n_limbs * 11),
-            _bits_of(P["t2"], self.p_s2, self.p_s2.n_limbs * 11),
+            _bits_of(P["t1"], self.p_t1, self.p_t1.n_limbs * 7),
+            _bits_of(P["t2"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         rhs = A.ctx_nt.mulmod(T["w"], A.ctx_nt.powmod(T["t"], e_bits))
-        ok = ok & jnp.all(lhs == rhs, axis=-1)
-        n2 = A.pb.ctx_N2
-        t1_modN = A.pb.ctx_N.reduce(
-            bn.take_limbs(P["t1"], 0, 2 * A.pb.prof_n.n_limbs)
+        ok = ok & _eq_all(lhs, rhs)
+        n2 = A.pmx.ctx_N2
+        t1_modN = A.pmx.ctx_N.reduce(
+            bn.take_limbs(P["t1"], 0, min(P["t1"].shape[-1], 2 * A.pmx.prof_n.n_limbs))
         )
         lhs = n2.mulmod(
             n2.mulmod(
-                n2.powmod(c_a, _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 11)),
-                _enc_deterministic(A.pb, t1_modN),
+                n2.powmod(c_a, _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7)),
+                A.pmx.enc_deterministic(t1_modN),
             ),
-            n2.powmod_const(bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N),
+            n2.powmod_const_exp(
+                bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N
+            ),
         )
         rhs = n2.mulmod(T["v"], n2.powmod(T["c_b"], e_bits))
-        return ok & jnp.all(lhs == rhs, axis=-1)
+        return ok & _eq_all(lhs, rhs)
 
     def alice_decrypt_share(self, c_b) -> jnp.ndarray:
         """Dec_A(c_b) mod q → curve-scalar limbs (12-bit family)."""
         A = self.alice
-        plain = A.pb.decrypt(A.pre.paillier, c_b)  # (B, n) mod N
-        return _mod_q_from_limbs(plain, A.pb.prof_n)
+        plain = A.pmx.decrypt(c_b)  # (B, n) mod N, 7-bit limbs
+        return _mod_q_from_limbs(plain, A.pmx.prof_n)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _bits_pack(bits: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
+    """(..., n_bits) LSB-first bit tensor → normalized limbs in prof."""
+    n_bits = bits.shape[-1]
+    want = prof.n_limbs * prof.bits
+    if n_bits < want:
+        bits = jnp.pad(
+            bits, [(0, 0)] * (bits.ndim - 1) + [(0, want - n_bits)]
+        )
+    else:
+        bits = bits[..., :want]
+    groups = bits.reshape(bits.shape[:-1] + (prof.n_limbs, prof.bits))
+    w = 1 << jnp.arange(prof.bits, dtype=jnp.int32)
+    return jnp.sum(groups * w, axis=-1).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -437,10 +504,10 @@ def _base_mul_compressed(k_limbs: jnp.ndarray):
     return pt, sp.compress(pt)
 
 
-def _scalar_to_plain(pb: PaillierBatch, k_limbs: jnp.ndarray) -> jnp.ndarray:
-    """curve scalar (12-bit limbs) → Paillier plaintext limbs (11-bit)."""
+def _scalar_to_plain(pmx, k_limbs: jnp.ndarray) -> jnp.ndarray:
+    """curve scalar (12-bit limbs) → Paillier plaintext limbs (7-bit)."""
     b = bn.limbs_to_bytes_le(k_limbs, P256, 32)
-    return bn.bytes_to_limbs_le(b, pb.prof_n, pb.prof_n.n_limbs)
+    return bn.bytes_to_limbs_le(b, pmx.prof_n, pmx.prof_n.n_limbs)
 
 
 def _scalar_to_prof(k_limbs: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
@@ -448,6 +515,7 @@ def _scalar_to_prof(k_limbs: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
     return bn.bytes_to_limbs_le(b, prof, prof.n_limbs)
 
 
+@functools.partial(jax.jit, static_argnums=1)
 def _mod_q_from_limbs(x: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
     """Reduce an arbitrary-width non-negative value mod q → 12-bit curve
     limbs, via chunked folding: v = Σ chunk_i · (2^(176·i)) mod q."""
@@ -470,20 +538,198 @@ def _mod_q_from_limbs(x: jnp.ndarray, prof: bn.LimbProfile) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# two-party batched co-signing fabric (bench / loopback deployments)
+# curve-phase BLOCKS — jitted at per-party granularity so one compiled
+# executable is reused q times per sign and shared across runs. (Both
+# extremes failed on this host: fusing a whole phase into one jit produced
+# 15+ minute XLA compiles; fully-eager execution paid ~ms of dispatch per
+# primitive across tens of thousands of curve ops. Party domain separation
+# rides an index-byte OPERAND, not per-party hash tags, so block HLO is
+# party-independent.)
+# ---------------------------------------------------------------------------
+
+
+def _idx_row(i: int, B: int) -> jnp.ndarray:
+    return jnp.full((B, 1), i, jnp.uint8)
+
+
+@jax.jit
+def _blk_commit(tagged_payload_rows):
+    """Generic hash commitment over pre-concatenated (B, L) uint8 rows."""
+    return dev_sha256(tagged_payload_rows)
+
+
+@jax.jit
+def _blk_gamma(gamma_i, blind_i, idx):
+    """Γ_i = γ_i·G, compressed + hash-committed (round 1, per party)."""
+    pt = sp.base_mul(bn.limbs_to_bits(gamma_i, P256, SCALAR_BITS))
+    comp = sp.compress(pt)
+    commit = dev_hash(b"gamma", idx, blind_i, comp)
+    return pt, comp, commit
+
+
+@jax.jit
+def _blk_gamma_check(blind_i, comp_i, idx, commit_i):
+    return _eq_all(dev_hash(b"gamma", idx, blind_i, comp_i), commit_i)
+
+
+@jax.jit
+def _blk_point_add(a: sp.SecpPointJ, b: sp.SecpPointJ) -> sp.SecpPointJ:
+    return sp.add(a, b)
+
+
+@jax.jit
+def _blk_point_eq(a: sp.SecpPointJ, b: sp.SecpPointJ) -> jnp.ndarray:
+    return sp.equal(a, b)
+
+
+@jax.jit
+def _blk_R(delta, Gamma_sum):
+    """δ⁻¹·ΣΓ, r = R_x mod q, recovery metadata, degeneracy flags."""
+    ring = sp.scalar_ring()
+    ok = ~jnp.all(delta == 0, axis=-1)
+    delta_inv = ring.powmod_const(delta, Q - 2)
+    R_pt = sp.scalar_mul(
+        bn.limbs_to_bits(delta_inv, P256, SCALAR_BITS), Gamma_sum
+    )
+    Rx = sp.x_coordinate(R_pt)
+    r = ring.reduce(Rx)
+    ok = ok & ~jnp.all(r == 0, axis=-1)
+    F = secp256k1_field()
+    zi = F.inv(R_pt.Z)
+    y_aff = F.canonical(F.mul(R_pt.Y, zi))
+    n_limbs_ = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q, P256)), Rx.shape)
+    rec = (y_aff[..., 0] & 1) | jnp.where(bn.compare(Rx, n_limbs_) >= 0, 2, 0)
+    return ok, R_pt, r, rec
+
+
+@jax.jit
+def _blk_schnorr(kpok_i, gamma_i, Gamma_i, comp_i, idx):
+    """Batched Schnorr PoK of γ_i: prove + self-verify (honest fabric)."""
+    ring = sp.scalar_ring()
+    A_pt = sp.base_mul(bn.limbs_to_bits(kpok_i, P256, SCALAR_BITS))
+    A_comp = sp.compress(A_pt)
+    e32 = dev_hash(b"schnorr", idx, A_comp, comp_i)
+    e = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    s_pok = ring.submod(kpok_i, ring.mulmod(e, gamma_i))
+    lhs = sp.add(
+        sp.base_mul(bn.limbs_to_bits(s_pok, P256, SCALAR_BITS)),
+        sp.scalar_mul(bn.limbs_to_bits(e, P256, SCALAR_BITS), Gamma_i),
+    )
+    return _eq_all(sp.compress(lhs), A_comp)
+
+
+@jax.jit
+def _blk_va(m, r, k_i, sigma_i, l_i, rho_i, R_pt, blind_i, idx):
+    """Phase 5A per party: s_i, V_i = s_i·R + l_i·G, A_i = ρ_i·G, commit."""
+    ring = sp.scalar_ring()
+    s_i = ring.addmod(ring.mulmod(m, k_i), ring.mulmod(r, sigma_i))
+    V_i = sp.add(
+        sp.scalar_mul(bn.limbs_to_bits(s_i, P256, SCALAR_BITS), R_pt),
+        sp.base_mul(bn.limbs_to_bits(l_i, P256, SCALAR_BITS)),
+    )
+    A_i = sp.base_mul(bn.limbs_to_bits(rho_i, P256, SCALAR_BITS))
+    vc, ac = sp.compress(V_i), sp.compress(A_i)
+    commit = dev_hash(b"VA", idx, blind_i, vc, ac)
+    return s_i, V_i, A_i, vc, ac, commit
+
+
+@jax.jit
+def _blk_pedersen(ka, kb, s_i, l_i, V_i, R_pt, vc, ac, blind_i, idx, commit):
+    """Phase 5B per party: decommit check + PedersenPoK of (s_i, l_i)."""
+    ring = sp.scalar_ring()
+    ok = _eq_all(dev_hash(b"VA", idx, blind_i, vc, ac), commit)
+    Apok = sp.add(
+        sp.scalar_mul(bn.limbs_to_bits(ka, P256, SCALAR_BITS), R_pt),
+        sp.base_mul(bn.limbs_to_bits(kb, P256, SCALAR_BITS)),
+    )
+    Apok_comp = sp.compress(Apok)
+    e32 = dev_hash(b"pedersen", idx, Apok_comp, vc, ac)
+    e5 = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    sa = ring.submod(ka, ring.mulmod(e5, s_i))
+    sb = ring.submod(kb, ring.mulmod(e5, l_i))
+    lhs = sp.add(
+        sp.add(
+            sp.scalar_mul(bn.limbs_to_bits(sa, P256, SCALAR_BITS), R_pt),
+            sp.base_mul(bn.limbs_to_bits(sb, P256, SCALAR_BITS)),
+        ),
+        sp.scalar_mul(bn.limbs_to_bits(e5, P256, SCALAR_BITS), V_i),
+    )
+    return ok & _eq_all(sp.compress(lhs), Apok_comp)
+
+
+@jax.jit
+def _blk_V(V_sum, m, r, Y):
+    """V = ΣV_i - m·G - r·Y (phase 5C prelude)."""
+    m_bits = bn.limbs_to_bits(m, P256, SCALAR_BITS)
+    return sp.add(
+        V_sum,
+        sp.add(
+            sp.neg(sp.base_mul(m_bits)),
+            sp.neg(sp.scalar_mul(bn.limbs_to_bits(r, P256, SCALAR_BITS), Y)),
+        ),
+    )
+
+
+@jax.jit
+def _blk_ut(rho_i, l_i, V, A_sum, blind_i, idx):
+    """Phase 5C per party: U_i = ρ_i·V, T_i = l_i·ΣA, commit."""
+    U_i = sp.scalar_mul(bn.limbs_to_bits(rho_i, P256, SCALAR_BITS), V)
+    T_i = sp.scalar_mul(bn.limbs_to_bits(l_i, P256, SCALAR_BITS), A_sum)
+    uc, tc = sp.compress(U_i), sp.compress(T_i)
+    commit = dev_hash(b"UT", idx, blind_i, uc, tc)
+    return U_i, T_i, uc, tc, commit
+
+
+@jax.jit
+def _blk_ut_check(blind_i, uc, tc, idx, commit):
+    return _eq_all(dev_hash(b"UT", idx, blind_i, uc, tc), commit)
+
+
+@jax.jit
+def _blk_final(s, m, r, Y, rec):
+    """Low-s normalize + batched ECDSA verification x(u1·G+u2·Y) == r."""
+    ring = sp.scalar_ring()
+    ok = ~jnp.all(s == 0, axis=-1)
+    half = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q // 2, P256)), s.shape)
+    high = bn.compare(s, half) > 0
+    s = jnp.where(high[..., None], ring.negmod(s), s)
+    rec = jnp.where(high, rec ^ 1, rec)
+    s_inv = ring.powmod_const(s, Q - 2)
+    u1 = ring.mulmod(m, s_inv)
+    u2 = ring.mulmod(r, s_inv)
+    Rv = sp.add(
+        sp.base_mul(bn.limbs_to_bits(u1, P256, SCALAR_BITS)),
+        sp.scalar_mul(bn.limbs_to_bits(u2, P256, SCALAR_BITS), Y),
+    )
+    ok = ok & jnp.all(ring.reduce(sp.x_coordinate(Rv)) == r, axis=-1)
+    return ok, s, rec
+
+
+@jax.jit
+def _withcheck_curve(s1_q, e_q, U_pt, W_pt):
+    """MtAwc curve binding: s1·G ?= U + e·W → (B,) bool."""
+    lhs = sp.base_mul(bn.limbs_to_bits(s1_q, P256, SCALAR_BITS))
+    rhs = sp.add(
+        U_pt,
+        sp.scalar_mul(bn.limbs_to_bits(e_q, P256, SCALAR_BITS), W_pt),
+    )
+    return sp.equal(lhs, rhs)
+
+
+# ---------------------------------------------------------------------------
+# q-party batched co-signing fabric (bench / loopback deployments)
 # ---------------------------------------------------------------------------
 
 
 class GG18BatchCoSigners:
-    """Runs B concurrent 2-of-n GG18 signing sessions with both signers'
-    round compute batched on device (the in-process measurement fabric —
-    the distributed node runs the same kernels per party).
+    """Runs B concurrent (t+1)-of-n GG18 signing sessions with every
+    signer's round compute batched on device (the in-process measurement
+    fabric — the distributed node runs the same kernels per party).
 
+    ``party_ids``: the signing quorum (any ≥ t+1 subset of the keygen
+    universe — reference ecdsa_signing_session.go:96-139).
     ``party_shares[i]`` are signer i's per-wallet shares (same wallet order
-    across parties, one quorum topology per batch — like
-    eddsa_batch.BatchedCoSigners). Quorum size is fixed at 2 (the
-    reference's default 2-of-3 deployment); wider quorums add directions
-    pairwise.
+    across parties, one quorum topology per batch).
     """
 
     def __init__(
@@ -494,7 +740,8 @@ class GG18BatchCoSigners:
         dom: Domains = Domains(),
         rng=secrets,
     ):
-        assert len(party_ids) == 2, "fabric currently models the 2-signer quorum"
+        self.q = len(party_ids)
+        assert self.q >= 2, "need at least a 2-party quorum"
         self.ids = list(party_ids)
         self.B = len(party_shares[0])
         self.dom = dom
@@ -502,13 +749,20 @@ class GG18BatchCoSigners:
         self.ring = sp.scalar_ring()
 
         first = party_shares[0][0]
+        assert self.q >= first.threshold + 1, "quorum below threshold+1"
         universe_xs = party_xs(first.participants)
         quorum_xs = [universe_xs[p] for p in party_ids]
-        self.ctx = [PartyCtx(pid, preparams[pid]) for pid in party_ids]
-        # both MtA directions
+        self.ctx = [PartyCtx(pid, preparams[pid], rng) for pid in party_ids]
+        # all ordered MtA directions
+        self.pairs = [
+            (a, b)
+            for a in range(self.q)
+            for b in range(self.q)
+            if a != b
+        ]
         self.mta = {
-            (0, 1): MtaBatch(self.ctx[0], self.ctx[1], dom),
-            (1, 0): MtaBatch(self.ctx[1], self.ctx[0], dom),
+            (a, b): MtaBatch(self.ctx[a], self.ctx[b], dom)
+            for (a, b) in self.pairs
         }
         # additive shares w_i = λ_i·x_i mod q (λ shared across the batch)
         self.w = []
@@ -532,93 +786,130 @@ class GG18BatchCoSigners:
     # -- small helpers -------------------------------------------------------
 
     def _rand_scalar(self) -> jnp.ndarray:
-        return _scalar_from_wide_bytes(jnp.asarray(rand_bits(self.B, 320, self.rng)))
+        return _scalar_from_wide_bytes(
+            jnp.asarray(rand_bits(self.B, 320, self.rng))
+        )
 
-    def _commit(self, tag: bytes, *rows) -> Tuple[np.ndarray, np.ndarray]:
-        blind = rand_bits(self.B, 256, self.rng)
-        return hash_rows(tag, blind, *rows), blind
+    def _rand_scalars_q(self) -> jnp.ndarray:
+        """(q, B, 22) uniform scalars mod q (one upload + one dispatch)."""
+        raw = rand_bits(self.q * self.B, 320, self.rng).reshape(
+            self.q, self.B, 40
+        )
+        return _scalar_from_wide_bytes(jnp.asarray(raw))
+
+    def _blinds_q(self) -> jnp.ndarray:
+        return jnp.asarray(
+            rand_bits(self.q * self.B, 256, self.rng).reshape(
+                self.q, self.B, 32
+            )
+        )
 
     # -- the protocol --------------------------------------------------------
 
-    def sign(self, digests: np.ndarray) -> Dict[str, np.ndarray]:
+    def sign(
+        self, digests: np.ndarray, phase_times: Optional[dict] = None
+    ) -> Dict[str, np.ndarray]:
         """``digests``: (B, 32) big-endian digests. Returns dict with
-        r, s (B, 32 BE bytes), recovery (B,), ok mask (B,)."""
-        B = self.B
+        r, s (B, 32 BE bytes), recovery (B,), ok mask (B,).
+
+        ``phase_times``: optional dict — when given, the engine blocks at
+        phase boundaries and records wall seconds per protocol phase
+        (bench diagnostics; adds sync overhead)."""
+        import time as _time
+
+        def _mark(name, *tensors):
+            if phase_times is not None:
+                for t in tensors:
+                    jax.block_until_ready(t)
+                now = _time.perf_counter()
+                phase_times[name] = now - _mark.last
+                _mark.last = now
+
+        _mark.last = _time.perf_counter()
+        B, q = self.B, self.q
         ring = self.ring
-        # m = digest mod q  (big-endian → little for limb decode)
         m = ring.reduce(
             bn.bytes_to_limbs_le(jnp.asarray(digests[:, ::-1].copy()), P256, 22)
         )
-        m_bits = bn.limbs_to_bits(m, P256, SCALAR_BITS)
 
-        # ---- round 1: k, γ, Γ commitments + MtA inits ----------------------
-        k = [self._rand_scalar() for _ in range(2)]
-        gamma = [self._rand_scalar() for _ in range(2)]
-        Gamma, Gamma_comp, g_commit, g_blind = [], [], [], []
-        for i in range(2):
-            pt, comp = _base_mul_compressed(gamma[i])
+        # ---- round 1: k, γ, Γ commitments; shared c_i = Enc_i(k_i) ---------
+        k_st = self._rand_scalars_q()
+        gamma_st = self._rand_scalars_q()
+        k = [k_st[i] for i in range(q)]
+        gamma = [gamma_st[i] for i in range(q)]
+        g_blind = self._blinds_q()
+        Gamma, Gamma_comp, g_commit = [], [], []
+        for i in range(q):
+            pt, comp, commit = _blk_gamma(gamma_st[i], g_blind[i], _idx_row(i, B))
             Gamma.append(pt)
-            Gamma_comp.append(np.asarray(comp))
-            c, bl = self._commit(b"gamma", Gamma_comp[i])
-            g_commit.append(c)
-            g_blind.append(bl)
+            Gamma_comp.append(comp)
+            g_commit.append(commit)
 
-        mta_state = {}
-        for (a, b), mta in self.mta.items():
+        # per-party encryption of k_i (one ciphertext reused by all pairs)
+        c_k, u_k, k_plain = [], [], []
+        for i in range(q):
+            u_bits = rand_bit_tensor(B, RAND_BITS, self.rng)
+            kp = _scalar_to_plain(self.ctx[i].pmx, k[i])
+            c, _r = self.ctx[i].pmx.encrypt(kp, u_bits)
+            c_k.append(c)
+            u_k.append(u_bits)
+            k_plain.append(kp)
+
+        mta_state: Dict[Tuple[int, int], Dict] = {}
+        for (a, b) in self.pairs:
+            mta = self.mta[(a, b)]
             Ra = mta.alice_randoms(B, self.rng)
-            k_plain = _scalar_to_plain(self.ctx[a].pb, k[a])
-            T = mta.alice_init(k_plain, Ra)
-            e = mta.e_limbs(mta.alice_challenge(T))
-            P = mta.alice_finish(e, k_plain, Ra)
-            mta_state[(a, b)] = {
-                "Ra": Ra, "T": T, "e": e, "P": P, "k_plain": k_plain,
-            }
+            T = mta.alice_init(k_plain[a], Ra)
+            e = mta.e_limbs(mta.alice_challenge(c_k[a], T))
+            P = mta.alice_finish(e, k_plain[a], Ra, u_k[a])
+            mta_state[(a, b)] = {"Ra": Ra, "T": T, "e": e, "P": P}
+        _mark("r1_commit_encrypt_rangeproof",
+              *[mta_state[p]["P"]["s"] for p in self.pairs])
 
         ok = jnp.ones((B,), bool)
 
         # ---- round 2: Bob verifies + responds (γ and w) --------------------
-        for (a, b), mta in self.mta.items():
+        for (a, b) in self.pairs:
+            mta = self.mta[(a, b)]
             st = mta_state[(a, b)]
-            ok = ok & mta.bob_check_alice(st["T"], st["P"], st["e"])
+            ok = ok & mta.bob_check_alice(c_k[a], st["T"], st["P"], st["e"])
             for name, secret in (("gamma", gamma[b]), ("w", self.w[b])):
                 Rb = mta.bob_randoms(B, self.rng)
                 b_e = _scalar_to_prof(secret, mta.p_e)
-                Tb = mta.bob_respond(st["T"]["c_a"], b_e, Rb,
-                                     with_check=(name == "w"))
+                Tb = mta.bob_respond(c_k[a], b_e, Rb)
                 extra = ()
                 U_pt = None
                 if name == "w":
                     alpha_q = _mod_q_from_limbs(Rb["alpha"], mta.p_alpha)
                     U_pt, U_comp = _base_mul_compressed(alpha_q)
                     X_comp = sp.compress(self.W_pts[b])
-                    extra = (np.asarray(U_comp), np.asarray(X_comp))
-                e_b = mta.e_limbs(mta.bob_challenge(st["T"]["c_a"], Tb, extra))
+                    extra = (U_comp, X_comp)
+                e_b = mta.e_limbs(mta.bob_challenge(c_k[a], Tb, extra))
                 Pb = mta.bob_finish(e_b, b_e, Rb)
                 st[name] = {"Rb": Rb, "Tb": Tb, "e": e_b, "Pb": Pb, "U": U_pt}
 
+        _mark("r2_mta_respond", ok,
+              *[mta_state[p]["w"]["Tb"]["c_b"] for p in self.pairs])
+
         # ---- round 3: Alice verifies + decrypts; δ_i, σ_i ------------------
-        alpha_shares = {}   # (a,b,name) -> alice's additive share mod q
-        beta_shares = {}    # (a,b,name) -> bob's additive share mod q
-        for (a, b), mta in self.mta.items():
+        alpha_shares = {}   # (a, b, name) -> alice's additive share mod q
+        beta_shares = {}    # (a, b, name) -> bob's additive share mod q
+        for (a, b) in self.pairs:
+            mta = self.mta[(a, b)]
             st = mta_state[(a, b)]
             for name in ("gamma", "w"):
                 sub = st[name]
                 ok = ok & mta.alice_check_bob(
-                    st["T"]["c_a"], sub["Tb"], sub["Pb"], sub["e"]
+                    c_k[a], sub["Tb"], sub["Pb"], sub["e"]
                 )
                 if name == "w":
-                    # with-check: s1·G ?= U + e·W_b
-                    s1_q = _mod_q_from_limbs(sub["Pb"]["s1"], mta.p_s1)
-                    lhs = sp.base_mul(bn.limbs_to_bits(s1_q, P256, SCALAR_BITS))
-                    e_q = _mod_q_from_limbs(sub["e"], mta.p_e)
-                    rhs = sp.add(
+                    # with-check: s1·G ?= U + e·W_b (one fused dispatch)
+                    ok = ok & _withcheck_curve(
+                        _mod_q_from_limbs(sub["Pb"]["s1"], mta.p_s1),
+                        _mod_q_from_limbs(sub["e"], mta.p_e),
                         sub["U"],
-                        sp.scalar_mul(
-                            bn.limbs_to_bits(e_q, P256, SCALAR_BITS),
-                            self.W_pts[b],
-                        ),
+                        self.W_pts[b],
                     )
-                    ok = ok & sp.equal(lhs, rhs)
                 alpha_shares[(a, b, name)] = mta.alice_decrypt_share(
                     sub["Tb"]["c_b"]
                 )
@@ -627,148 +918,100 @@ class GG18BatchCoSigners:
                 )
 
         delta_i, sigma_i = [], []
-        for i in range(2):
-            j = 1 - i
-            d = ring.addmod(
-                ring.mulmod(k[i], gamma[i]),
-                ring.addmod(
-                    alpha_shares[(i, j, "gamma")], beta_shares[(j, i, "gamma")]
-                ),
-            )
-            s_ = ring.addmod(
-                ring.mulmod(k[i], self.w[i]),
-                ring.addmod(
-                    alpha_shares[(i, j, "w")], beta_shares[(j, i, "w")]
-                ),
-            )
+        for i in range(q):
+            d = ring.mulmod(k[i], gamma[i])
+            s_ = ring.mulmod(k[i], self.w[i])
+            for j in range(q):
+                if j == i:
+                    continue
+                d = ring.addmod(
+                    d,
+                    ring.addmod(
+                        alpha_shares[(i, j, "gamma")],
+                        beta_shares[(j, i, "gamma")],
+                    ),
+                )
+                s_ = ring.addmod(
+                    s_,
+                    ring.addmod(
+                        alpha_shares[(i, j, "w")], beta_shares[(j, i, "w")]
+                    ),
+                )
             delta_i.append(d)
             sigma_i.append(s_)
 
-        # ---- round 4: δ reveal, Γ decommit + PoK, R ------------------------
-        for i in range(2):
-            again = hash_rows(b"gamma", g_blind[i], Gamma_comp[i])
-            ok = ok & jnp.asarray((again == g_commit[i]).all(axis=1))
-        delta = ring.addmod(delta_i[0], delta_i[1])
-        nz = ~jnp.all(delta == 0, axis=-1)
-        ok = ok & nz
-        delta_inv = ring.powmod_const(delta, Q - 2)
-        Gamma_sum = sp.add(Gamma[0], Gamma[1])
-        R_pt = sp.scalar_mul(
-            bn.limbs_to_bits(delta_inv, P256, SCALAR_BITS), Gamma_sum
-        )
-        Rx = sp.x_coordinate(R_pt)          # canonical field limbs
-        r = ring.reduce(Rx)
-        ok = ok & ~jnp.all(r == 0, axis=-1)
-        # recovery metadata
-        F = __import__("mpcium_tpu.core.fields", fromlist=["secp256k1_field"]).secp256k1_field()
-        zi = F.inv(R_pt.Z)
-        y_aff = F.canonical(F.mul(R_pt.Y, zi))
-        n_limbs_ = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q, P256)), Rx.shape)
-        rec = (y_aff[..., 0] & 1) | jnp.where(bn.compare(Rx, n_limbs_) >= 0, 2, 0)
+        _mark("r3_verify_decrypt", ok, *delta_i, *sigma_i)
 
-        # Schnorr PoK of γ_i (batched prove + cross-verify)
-        for i in range(2):
-            k_pok = self._rand_scalar()
-            _, A_comp = _base_mul_compressed(k_pok)
-            e32 = hash_rows(b"schnorr", np.asarray(A_comp), Gamma_comp[i])
-            e_pok = ring.reduce(
-                bn.bytes_to_limbs_le(jnp.asarray(e32), P256, 22)
+        # ---- rounds 4-9: R reconstruction + phase 5 (jitted per-party
+        # blocks, each compiled once and reused q times) ------------------
+        for i in range(q):
+            ok = ok & _blk_gamma_check(
+                g_blind[i], Gamma_comp[i], _idx_row(i, B), g_commit[i]
             )
-            s_pok = ring.submod(k_pok, ring.mulmod(e_pok, gamma[i]))
-            lhs = sp.add(
-                sp.base_mul(bn.limbs_to_bits(s_pok, P256, SCALAR_BITS)),
-                sp.scalar_mul(bn.limbs_to_bits(e_pok, P256, SCALAR_BITS), Gamma[i]),
+        delta = delta_i[0]
+        Gamma_sum = Gamma[0]
+        for i in range(1, q):
+            delta = ring.addmod(delta, delta_i[i])
+            Gamma_sum = _blk_point_add(Gamma_sum, Gamma[i])
+        ok_R, R_pt, r, rec = _blk_R(delta, Gamma_sum)
+        ok = ok & ok_R
+        kpok = self._rand_scalars_q()
+        for i in range(q):
+            ok = ok & _blk_schnorr(
+                kpok[i], gamma[i], Gamma[i], Gamma_comp[i], _idx_row(i, B)
             )
-            ok = ok & jnp.asarray(
-                (np.asarray(sp.compress(lhs)) == np.asarray(A_comp)).all(axis=1)
-            )
+        _mark("r4_R_reconstruct_pok", ok, r)
 
-        # ---- phase 5 -------------------------------------------------------
-        s_i, l_i, rho5, V_i, A_i = [], [], [], [], []
-        V_comp, A_comp5, va_commit, va_blind = [], [], [], []
-        for i in range(2):
-            si = ring.addmod(ring.mulmod(m, k[i]), ring.mulmod(r, sigma_i[i]))
-            li = self._rand_scalar()
-            ri = self._rand_scalar()
-            Vi = sp.add(
-                sp.scalar_mul(bn.limbs_to_bits(si, P256, SCALAR_BITS), R_pt),
-                sp.base_mul(bn.limbs_to_bits(li, P256, SCALAR_BITS)),
+        # phase 5A: commitments to V_i, A_i
+        li = self._rand_scalars_q()
+        ri = self._rand_scalars_q()
+        ka = self._rand_scalars_q()
+        kb = self._rand_scalars_q()
+        va_blind = self._blinds_q()
+        ut_blind = self._blinds_q()
+        s_i, V_i, A_i, V_c, A_c, va_commit = [], [], [], [], [], []
+        for i in range(q):
+            si, Vi, Ai, vc, ac, cmt = _blk_va(
+                m, r, k[i], sigma_i[i], li[i], ri[i], R_pt, va_blind[i],
+                _idx_row(i, B),
             )
-            Ai, Ai_comp = _base_mul_compressed(ri)
-            s_i.append(si); l_i.append(li); rho5.append(ri)
-            V_i.append(Vi); A_i.append(Ai)
-            vc = np.asarray(sp.compress(Vi))
-            V_comp.append(vc); A_comp5.append(np.asarray(Ai_comp))
-            c, bl = self._commit(b"VA", vc, A_comp5[i])
-            va_commit.append(c); va_blind.append(bl)
-
-        # decommit + PedersenPoK of (s_i, l_i) in V_i = s_i·R + l_i·G
-        for i in range(2):
-            again = hash_rows(b"VA", va_blind[i], V_comp[i], A_comp5[i])
-            ok = ok & jnp.asarray((again == va_commit[i]).all(axis=1))
-            ka, kb = self._rand_scalar(), self._rand_scalar()
-            Apok = sp.add(
-                sp.scalar_mul(bn.limbs_to_bits(ka, P256, SCALAR_BITS), R_pt),
-                sp.base_mul(bn.limbs_to_bits(kb, P256, SCALAR_BITS)),
+            s_i.append(si); V_i.append(Vi); A_i.append(Ai)
+            V_c.append(vc); A_c.append(ac); va_commit.append(cmt)
+        # phase 5B: decommit + PedersenPoK
+        for i in range(q):
+            ok = ok & _blk_pedersen(
+                ka[i], kb[i], s_i[i], li[i], V_i[i], R_pt, V_c[i], A_c[i],
+                va_blind[i], _idx_row(i, B), va_commit[i],
             )
-            Apok_comp = np.asarray(sp.compress(Apok))
-            e32 = hash_rows(b"pedersen", Apok_comp, V_comp[i], A_comp5[i])
-            e5 = ring.reduce(bn.bytes_to_limbs_le(jnp.asarray(e32), P256, 22))
-            sa = ring.submod(ka, ring.mulmod(e5, s_i[i]))
-            sb = ring.submod(kb, ring.mulmod(e5, l_i[i]))
-            lhs = sp.add(
-                sp.add(
-                    sp.scalar_mul(bn.limbs_to_bits(sa, P256, SCALAR_BITS), R_pt),
-                    sp.base_mul(bn.limbs_to_bits(sb, P256, SCALAR_BITS)),
-                ),
-                sp.scalar_mul(bn.limbs_to_bits(e5, P256, SCALAR_BITS), V_i[i]),
+        # phase 5C/5D: U/T commit–reveal + ΣU == ΣT
+        V_sum, A_sum = V_i[0], A_i[0]
+        for i in range(1, q):
+            V_sum = _blk_point_add(V_sum, V_i[i])
+            A_sum = _blk_point_add(A_sum, A_i[i])
+        V = _blk_V(V_sum, m, r, self.Y)
+        U_pts, T_pts, U_c, T_c, ut_commit = [], [], [], [], []
+        for i in range(q):
+            Ui, Ti, uc, tc, cmt = _blk_ut(
+                ri[i], li[i], V, A_sum, ut_blind[i], _idx_row(i, B)
             )
-            ok = ok & jnp.asarray(
-                (np.asarray(sp.compress(lhs)) == Apok_comp).all(axis=1)
-            )
-
-        # V = ΣV_i - m·G - r·Y ;  U_i = ρ_i·V ;  T_i = l_i·A_sum
-        V = sp.add(
-            sp.add(V_i[0], V_i[1]),
-            sp.add(
-                sp.neg(sp.base_mul(m_bits)),
-                sp.neg(sp.scalar_mul(bn.limbs_to_bits(r, P256, SCALAR_BITS), self.Y)),
-            ),
-        )
-        A_sum = sp.add(A_i[0], A_i[1])
-        U_pts, T_pts, ut_commit, ut_blind, U_comp, T_comp = [], [], [], [], [], []
-        for i in range(2):
-            Ui = sp.scalar_mul(bn.limbs_to_bits(rho5[i], P256, SCALAR_BITS), V)
-            Ti = sp.scalar_mul(bn.limbs_to_bits(l_i[i], P256, SCALAR_BITS), A_sum)
             U_pts.append(Ui); T_pts.append(Ti)
-            uc, tc = np.asarray(sp.compress(Ui)), np.asarray(sp.compress(Ti))
-            U_comp.append(uc); T_comp.append(tc)
-            c, bl = self._commit(b"UT", uc, tc)
-            ut_commit.append(c); ut_blind.append(bl)
-        for i in range(2):
-            again = hash_rows(b"UT", ut_blind[i], U_comp[i], T_comp[i])
-            ok = ok & jnp.asarray((again == ut_commit[i]).all(axis=1))
-        ok = ok & sp.equal(
-            sp.add(U_pts[0], U_pts[1]), sp.add(T_pts[0], T_pts[1])
-        )
-
-        # ---- reveal s_i, combine, normalize, verify ------------------------
-        s = ring.addmod(s_i[0], s_i[1])
-        ok = ok & ~jnp.all(s == 0, axis=-1)
-        half = jnp.broadcast_to(jnp.asarray(bn.to_limbs(Q // 2, P256)), s.shape)
-        high = bn.compare(s, half) > 0
-        s = jnp.where(high[..., None], ring.negmod(s), s)
-        rec = jnp.where(high, rec ^ 1, rec)
-
-        # batched ECDSA verification: x(u1·G + u2·Y) mod q == r
-        s_inv = ring.powmod_const(s, Q - 2)
-        u1 = ring.mulmod(m, s_inv)
-        u2 = ring.mulmod(r, s_inv)
-        Rv = sp.add(
-            sp.base_mul(bn.limbs_to_bits(u1, P256, SCALAR_BITS)),
-            sp.scalar_mul(bn.limbs_to_bits(u2, P256, SCALAR_BITS), self.Y),
-        )
-        ok = ok & jnp.all(ring.reduce(sp.x_coordinate(Rv)) == r, axis=-1)
+            U_c.append(uc); T_c.append(tc); ut_commit.append(cmt)
+        for i in range(q):
+            ok = ok & _blk_ut_check(
+                ut_blind[i], U_c[i], T_c[i], _idx_row(i, B), ut_commit[i]
+            )
+        U_s, T_s = U_pts[0], T_pts[0]
+        for i in range(1, q):
+            U_s = _blk_point_add(U_s, U_pts[i])
+            T_s = _blk_point_add(T_s, T_pts[i])
+        ok = ok & _blk_point_eq(U_s, T_s)
+        # phase 5E: reveal + combine + verify
+        s = s_i[0]
+        for i in range(1, q):
+            s = ring.addmod(s, s_i[i])
+        ok_f, s, rec = _blk_final(s, m, r, self.Y, rec)
+        ok = ok & ok_f
+        _mark("r5_phase5_combine_verify", ok, s)
 
         return {
             "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),

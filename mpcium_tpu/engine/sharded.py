@@ -126,3 +126,33 @@ def sharded_sign(
         r, jnp.asarray(c64), jnp.asarray(lamx), R_sum, jnp.asarray(A_comp)
     )
     return np.asarray(sigs), np.asarray(ok) & np.asarray(ok_R)
+
+
+# ---------------------------------------------------------------------------
+# GG18: session-axis sharding (GSPMD)
+# ---------------------------------------------------------------------------
+
+
+def shard_gg18_sessions(signer, mesh: Mesh) -> None:
+    """Shard a GG18BatchCoSigners fabric's per-wallet state over the mesh's
+    SESSIONS axis (in place). Every GG18 kernel is batch-parallel — MXU
+    Toeplitz matmuls, powmod scans, curve ladders, device SHA-256 — so
+    GSPMD partitions each dispatch across devices once the operands carry a
+    sessions sharding; no collectives are needed inside a party.
+
+    The COMMITTEE axis for GG18 is deliberately NOT a mesh axis: each
+    party's Paillier/ring-Pedersen moduli are trust-domain-local compile
+    constants, so parties are separate programs exchanging round tensors
+    (in production: separate hosts — SURVEY.md §7.4 item 6). The EdDSA
+    engine above demonstrates the on-mesh committee axis where per-party
+    state is share-shaped, not modulus-shaped.
+    """
+    from jax.sharding import NamedSharding
+
+    s = NamedSharding(mesh, P(SESSIONS))
+    put = lambda x: jax.device_put(x, s)
+    signer.w = [put(w) for w in signer.w]
+    signer.W_pts = [
+        type(p)(*(put(f) for f in p)) for p in signer.W_pts
+    ]
+    signer.Y = type(signer.Y)(*(put(f) for f in signer.Y))

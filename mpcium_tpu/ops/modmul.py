@@ -121,15 +121,33 @@ def _toeplitz_np(c_limbs: Tuple[int, ...], n_in: int) -> np.ndarray:
     return T
 
 
-def _const_matrices(value: int, n_in: int) -> jnp.ndarray:
+def _const_matrices(
+    value: int, n_in: int, min_limbs: int = 1
+) -> jnp.ndarray:
     limbs = []
     v = value
     while v:
         limbs.append(v & MASK)
         v >>= LIMB_BITS
-    if not limbs:
-        limbs = [0]
+    while len(limbs) < min_limbs:
+        limbs.append(0)  # width-pad so same-modulus constants share shapes
     return jnp.asarray(_toeplitz_np(tuple(limbs), n_in), jnp.bfloat16)
+
+
+def ints_to_limbs(vals, prof: bn.LimbProfile) -> np.ndarray:
+    """Bulk python-int → limb conversion via byte packing (numpy-speed;
+    bn.to_limbs is a per-limb python loop — too slow for comb tables)."""
+    nbytes = -(-prof.bits * prof.n_limbs // 8)
+    raw = np.frombuffer(
+        b"".join(int(v).to_bytes(nbytes, "little") for v in vals),
+        dtype=np.uint8,
+    ).reshape(len(vals), nbytes)
+    bits = np.unpackbits(raw, axis=-1, bitorder="little")[
+        :, : prof.bits * prof.n_limbs
+    ]
+    groups = bits.reshape(len(vals), prof.n_limbs, prof.bits)
+    weights = (1 << np.arange(prof.bits)).astype(np.int64)
+    return (groups * weights).sum(-1).astype(np.int32)
 
 
 def mul_const(x: jnp.ndarray, T: jnp.ndarray) -> jnp.ndarray:
@@ -158,6 +176,156 @@ def mul_pair(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# module-level kernels (operand-passing: per-modulus constants arrive as
+# ARGUMENTS, so one compiled executable serves every modulus of a given
+# width — across parties, keys, processes, and the persistent cache)
+# ---------------------------------------------------------------------------
+
+
+def _cond_sub_impl(x: jnp.ndarray, comp: jnp.ndarray, occ: int) -> jnp.ndarray:
+    """x < 2m over occ+1 limbs -> x mod m (complement-add carry)."""
+    c = jnp.broadcast_to(comp, x.shape[:-1] + (occ + 2,))
+    u = carry(bn.pad_limbs(x, 1) + c)  # x - m + R^(occ+1)
+    ge = u[..., occ + 1] >= 1  # borrow-free <=> x >= m
+    return jnp.where(ge[..., None], u[..., : occ + 1], x)
+
+
+def _reduce_impl(x, T_mu, T_m, comp, occ: int, n: int) -> jnp.ndarray:
+    """Barrett reduce; x normalized <= 2n limbs, x < R^occ * m (any product
+    of two reduced values qualifies) -> x mod m over n limbs."""
+    if x.shape[-1] <= occ:
+        x = bn.pad_limbs(x, occ + 2 - x.shape[-1])
+    q1 = bn.take_limbs(x, occ - 1, x.shape[-1] - (occ - 1))
+    q2 = carry(mul_const(q1, T_mu[: q1.shape[-1]]))
+    q3 = bn.take_limbs(q2, occ + 1, q2.shape[-1] - (occ + 1))
+    q3m = carry(mul_const(q3, T_m[: q3.shape[-1]]))
+    # subtract via elementwise radix complement of q3m (keeps limbs
+    # non-negative for the lookahead carry); true r in [0, 3m) so the
+    # extra R^(occ+1) lands exactly in limb occ+1, dropped below
+    t = bn.take_limbs(x, 0, occ + 1) + (MASK - bn.take_limbs(q3m, 0, occ + 1))
+    t = bn.pad_limbs(t, 1).at[..., 0].add(1)
+    r = carry(t)[..., : occ + 1]
+    r = _cond_sub_impl(r, comp, occ)
+    r = _cond_sub_impl(r, comp, occ)
+    out = r[..., :occ]
+    return bn.pad_limbs(out, n - occ) if occ < n else out
+
+
+def _one_like(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jnp.zeros(x.shape[:-1] + (n,), jnp.int32).at[..., 0].set(1)
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_reduce(x, T_mu, T_m, comp, occ: int, n: int):
+    return _reduce_impl(x, T_mu, T_m, comp, occ, n)
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_mulmod(a, b, T_mu, T_m, comp, occ: int, n: int):
+    return _reduce_impl(mul_pair(a, b), T_mu, T_m, comp, occ, n)
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_mulmod_const(a, T_c, T_mu, T_m, comp, occ: int, n: int):
+    return _reduce_impl(carry(mul_const(a, T_c)), T_mu, T_m, comp, occ, n)
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_addmod(a, b, comp, occ: int, n: int):
+    s = carry(bn.pad_limbs(a + b, 1))  # < 2m
+    r = _cond_sub_impl(bn.take_limbs(s, 0, occ + 1), comp, occ)
+    out = r[..., :occ]
+    return bn.pad_limbs(out, n - occ) if occ < n else out
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_submod(a, b, m1, comp, occ: int, n: int):
+    # a - b + m via the elementwise complement of b (non-negative limbs)
+    t = (
+        bn.take_limbs(a, 0, occ + 1)
+        + (MASK - bn.take_limbs(b, 0, occ + 1))
+        + bn.pad_limbs(m1, 1)[..., : occ + 1]
+    )
+    t = bn.pad_limbs(t, 1).at[..., 0].add(1)
+    r = carry(t)[..., : occ + 1]  # a - b + m in (0, 2m); drop R^(occ+1)
+    r = _cond_sub_impl(r, comp, occ)
+    out = r[..., :occ]
+    return bn.pad_limbs(out, n - occ) if occ < n else out
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_powmod(x, ebits, T_mu, T_m, comp, occ: int, n: int):
+    """x^e, per-element exponent bits (LSB-first), 4-bit windows."""
+    n_bits = ebits.shape[-1]
+    nw = -(-n_bits // 4)
+    if nw * 4 != n_bits:
+        ebits = jnp.pad(
+            ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * 4 - n_bits)]
+        )
+    w = ebits.reshape(ebits.shape[:-1] + (nw, 4))
+    digits = jnp.flip(
+        (w * jnp.asarray([1, 2, 4, 8], jnp.int32)).sum(-1), axis=-1
+    )
+    rows = [_one_like(x, n), x]
+    for _ in range(14):
+        rows.append(_reduce_impl(mul_pair(rows[-1], x), T_mu, T_m, comp, occ, n))
+    tbl = jnp.stack(rows, axis=-2)
+
+    def step(acc, d):
+        for _ in range(4):
+            acc = _reduce_impl(mul_pair(acc, acc), T_mu, T_m, comp, occ, n)
+        sel = jnp.take_along_axis(
+            tbl, d[..., None, None].astype(jnp.int32), axis=-2
+        )[..., 0, :]
+        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+
+    acc, _ = lax.scan(step, _one_like(x, n), jnp.moveaxis(digits, -1, 0))
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_powmod_digits(x, digits, T_mu, T_m, comp, occ: int, n: int):
+    """x^e for a batch-shared exponent given as an MSD-first (nw,) digit
+    array (value is a runtime operand: one compile per digit COUNT)."""
+    rows = [_one_like(x, n), x]
+    for _ in range(14):
+        rows.append(_reduce_impl(mul_pair(rows[-1], x), T_mu, T_m, comp, occ, n))
+    tbl = jnp.stack(rows, axis=-2)
+
+    def step(acc, d):
+        for _ in range(4):
+            acc = _reduce_impl(mul_pair(acc, acc), T_mu, T_m, comp, occ, n)
+        sel = tbl[..., d, :]
+        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+
+    acc, _ = lax.scan(step, _one_like(x, n), digits)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("occ", "n"))
+def _k_powmod_fb(tbl, ebits, T_mu, T_m, comp, occ: int, n: int):
+    """comb-table fixed-base: tbl (nw, 16, n) operand, one mulmod/window."""
+    n_bits = ebits.shape[-1]
+    nw = tbl.shape[0]
+    if nw * 4 != n_bits:
+        ebits = jnp.pad(
+            ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * 4 - n_bits)]
+        )
+    w = ebits.reshape(ebits.shape[:-1] + (nw, 4))
+    digits = (w * jnp.asarray([1, 2, 4, 8], jnp.int32)).sum(-1)
+
+    def step(acc, sl):
+        d, rows = sl
+        sel = rows[d]
+        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+
+    acc, _ = lax.scan(
+        step, _one_like(ebits, n), (jnp.moveaxis(digits, -1, 0), tbl)
+    )
+    return acc
+
+
+# ---------------------------------------------------------------------------
 # the modular context
 # ---------------------------------------------------------------------------
 
@@ -165,13 +333,16 @@ def mul_pair(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 class MXUBarrett:
     """Barrett context for a fixed modulus with MXU-formulated primitives.
 
-    Same reduction algebra as bignum.BarrettCtx (HAC Alg. 14.42) — the mu
+    Same reduction algebra as bignum.BarrettCtx (HAC Alg. 14.42) - the mu
     and m products ride constant Toeplitz matmuls, carries use the
     lookahead path, and the two trailing conditional subtractions use the
-    radix-complement trick.
+    radix-complement trick. All per-modulus constants are passed to the
+    module-level kernels as OPERANDS so compiled executables are shared
+    across moduli of a width (critical on a 1-core host: one compile per
+    shape, hit by every party/key/process via the persistent cache).
 
     The modulus need NOT occupy the top limb (profiles are block-padded);
-    ``shift`` below is derived from the modulus' true limb occupancy.
+    the Barrett shift windows derive from the modulus' true occupancy.
     """
 
     def __init__(self, modulus: int, n_limbs: Optional[int] = None):
@@ -186,17 +357,17 @@ class MXUBarrett:
         n = self.prof.n_limbs
         assert occ <= n
         self.occ = occ
-        # Barrett: mu = floor(R^(2·occ) / m); q1 = x >> (occ-1) limbs;
-        # q3 = (q1·mu) >> (occ+1) limbs; r = x - q3·m over occ+1 limbs.
+        # Barrett: mu = floor(R^(2*occ) / m); q1 = x >> (occ-1) limbs;
+        # q3 = (q1*mu) >> (occ+1) limbs; r = x - q3*m over occ+1 limbs.
         self.mu = (1 << (2 * occ * LIMB_BITS)) // modulus
-        # reduce() accepts inputs up to 2n limbs, so q1 can have up to
-        # 2n - (occ-1) limbs — size the mu Toeplitz for that worst case
         self._T_mu = _const_matrices(self.mu, 2 * n - (occ - 1))
-        self._T_m = _const_matrices(modulus, 2 * n)  # q3 up to ~2n limbs
-        # complement constant R^(occ+1) - m, as occ+2 limbs
+        self._T_m = _const_matrices(modulus, 2 * n)
         comp = (1 << ((occ + 1) * LIMB_BITS)) - modulus
         self._comp = jnp.asarray(
             bn.to_limbs(comp, self.prof, n_limbs=occ + 2), jnp.int32
+        )
+        self._m1 = jnp.asarray(
+            bn.to_limbs(modulus, self.prof, occ + 1), jnp.int32
         )
         self.m_limbs = bn.to_limbs(modulus, self.prof)
         self._fb_tables: Dict = {}
@@ -208,199 +379,104 @@ class MXUBarrett:
         return jnp.broadcast_to(v, tuple(batch_shape) + (self.prof.n_limbs,))
 
     def one_like(self, x: jnp.ndarray) -> jnp.ndarray:
-        return (
-            jnp.zeros(x.shape[:-1] + (self.prof.n_limbs,), jnp.int32)
-            .at[..., 0]
-            .set(1)
-        )
-
-    def _cond_sub(self, x: jnp.ndarray) -> jnp.ndarray:
-        """x < 2m over occ+1 limbs → x mod m over occ+1 limbs (top zero
-        afterwards iff m occupies occ limbs). One complement-add carry."""
-        occ = self.occ
-        comp = jnp.broadcast_to(self._comp, x.shape[:-1] + (occ + 2,))
-        u = carry(bn.pad_limbs(x, 1) + comp)  # x - m + R^(occ+1)
-        ge = u[..., occ + 1] >= 1  # borrow-free ⇔ x >= m
-        return jnp.where(ge[..., None], u[..., : occ + 1], x)
+        return _one_like(x, self.prof.n_limbs)
 
     # -- core ---------------------------------------------------------------
 
-    @_jit_method
     def reduce(self, x: jnp.ndarray) -> jnp.ndarray:
-        """x normalized, any width ≤ 2n limbs, x < R^(occ)·m (true for any
-        product of two reduced values) → x mod m (n limbs, canonical)."""
-        occ, n = self.occ, self.prof.n_limbs
-        if x.shape[-1] <= occ:
-            # narrower than the modulus-quotient window: pad so the Barrett
-            # shift indexing below stays well-formed (q̂ comes out 0 or tiny)
-            x = bn.pad_limbs(x, occ + 2 - x.shape[-1])
-        q1 = bn.take_limbs(x, occ - 1, x.shape[-1] - (occ - 1))
-        T_mu = self._T_mu[: q1.shape[-1]]
-        q2 = carry(mul_const(q1, T_mu))
-        q3 = bn.take_limbs(q2, occ + 1, q2.shape[-1] - (occ + 1))
-        T_m = self._T_m[: q3.shape[-1]]
-        q3m = carry(mul_const(q3, T_m))
-        # r = (x - q3·m) mod R^(occ+1): both tails agree above occ+1 limbs.
-        # Subtract via the elementwise radix complement of q3m (keeps every
-        # limb non-negative → the fast lookahead carry applies): x - q3m +
-        # R^(occ+1) = x + ((R^(occ+1)-1) - q3m_low) + 1; true r ∈ [0, 3m)
-        # so the extra R^(occ+1) lands exactly in limb occ+1, dropped below.
-        t = (
-            bn.take_limbs(x, 0, occ + 1)
-            + (MASK - bn.take_limbs(q3m, 0, occ + 1))
+        return _k_reduce(
+            x, self._T_mu, self._T_m, self._comp, self.occ, self.prof.n_limbs
         )
-        t = bn.pad_limbs(t, 1).at[..., 0].add(1)
-        r = carry(t)[..., : occ + 1]
-        r = self._cond_sub(r)
-        r = self._cond_sub(r)
-        out = r[..., :occ]
-        if occ < n:
-            out = bn.pad_limbs(out, n - occ)
-        return out
 
-    @_jit_method
     def mulmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        return self.reduce(mul_pair(a, b))
+        return _k_mulmod(
+            a, b, self._T_mu, self._T_m, self._comp, self.occ,
+            self.prof.n_limbs,
+        )
 
-    @_jit_method
     def sqrmod(self, a: jnp.ndarray) -> jnp.ndarray:
-        return self.reduce(mul_pair(a, a))
+        return self.mulmod(a, a)
 
-    @_jit_method(static_argnums=(0, 2))
     def mulmod_const(self, a: jnp.ndarray, value: int) -> jnp.ndarray:
-        """a times a python-int constant (cached Toeplitz) mod m."""
+        """a times a python-int constant (cached width-padded Toeplitz)."""
         key = ("constT", value % self.modulus)
         T = self._fb_tables.get(key)
         if T is None:
-            T = _const_matrices(value % self.modulus, self.prof.n_limbs)
+            # pad the constant to occ limbs so every constant of this
+            # modulus shares one kernel shape
+            T = _const_matrices(
+                value % self.modulus, self.prof.n_limbs, min_limbs=self.occ
+            )
             self._fb_tables[key] = T
-        return self.reduce(carry(mul_const(a, T)))
-
-    @_jit_method
-    def addmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        occ, n = self.occ, self.prof.n_limbs
-        s = carry(bn.pad_limbs(a + b, 1))  # < 2m
-        r = self._cond_sub(bn.take_limbs(s, 0, occ + 1))
-        out = r[..., :occ]
-        return bn.pad_limbs(out, n - occ) if occ < n else out
-
-    @_jit_method
-    def submod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        occ, n = self.occ, self.prof.n_limbs
-        m1 = jnp.broadcast_to(
-            jnp.asarray(bn.to_limbs(self.modulus, self.prof, occ + 1)),
-            a.shape[:-1] + (occ + 1,),
+        return _k_mulmod_const(
+            a, T, self._T_mu, self._T_m, self._comp, self.occ,
+            self.prof.n_limbs,
         )
-        d = m1 + bn.take_limbs(a, 0, occ + 1) - bn.take_limbs(b, 0, occ + 1)
-        # a - b + m ∈ (0, 2m); negative intermediate limbs → bignum.carry
-        r = self._cond_sub(bn.carry(d, self.prof))
-        out = r[..., :occ]
-        return bn.pad_limbs(out, n - occ) if occ < n else out
+
+    def addmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return _k_addmod(a, b, self._comp, self.occ, self.prof.n_limbs)
+
+    def submod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return _k_submod(
+            a, b, self._m1, self._comp, self.occ, self.prof.n_limbs
+        )
 
     def negmod(self, a: jnp.ndarray) -> jnp.ndarray:
         return self.submod(jnp.zeros_like(a), a)
 
     # -- exponentiation -----------------------------------------------------
 
-    @_jit_method(static_argnums=(0, 2))
     def powmod_const_exp(self, x: jnp.ndarray, exponent: int) -> jnp.ndarray:
-        """x^e mod m, python-int exponent (shared across the batch).
-        Left-to-right 4-bit windows as ONE lax.scan over the digit list
-        (compile size stays O(1) in the exponent length — essential for
-        2048-bit exponents on this host)."""
+        """x^e mod m for a batch-shared python-int exponent (digit array is
+        a runtime operand: one compile per digit count, any value)."""
         if exponent == 0:
             return self.one_like(x)
-        # per-element table x^0..x^15: (..., 16, n)
-        rows = [self.one_like(x), x]
-        for _ in range(14):
-            rows.append(self.mulmod(rows[-1], x))
-        tbl = jnp.stack(rows, axis=-2)
         nw = -(-exponent.bit_length() // 4)
         digits = jnp.asarray(
             [(exponent >> (4 * i)) & 15 for i in range(nw)][::-1], jnp.int32
         )
-
-        def step(acc, d):
-            acc = self.sqrmod(self.sqrmod(self.sqrmod(self.sqrmod(acc))))
-            sel = tbl[..., d, :]
-            return self.mulmod(acc, sel), None
-
-        acc0 = self.one_like(x)
-        acc, _ = lax.scan(step, acc0, digits)
-        return acc
-
-    @_jit_method
-    def powmod(self, x: jnp.ndarray, ebits: jnp.ndarray) -> jnp.ndarray:
-        """x^e with per-element exponents: ``ebits`` (..., n_bits) int32,
-        LSB first. 4-bit windows with a per-element table gather:
-        n_bits + n_bits/4 mulmods (vs 2·n_bits for binary)."""
-        n_bits = ebits.shape[-1]
-        nw = -(-n_bits // 4)
-        if nw * 4 != n_bits:
-            ebits = jnp.pad(
-                ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * 4 - n_bits)]
-            )
-        # digits (..., nw) MSD-first
-        w = ebits.reshape(ebits.shape[:-1] + (nw, 4))
-        digits = jnp.flip(
-            (w * jnp.asarray([1, 2, 4, 8], jnp.int32)).sum(-1), axis=-1
+        return _k_powmod_digits(
+            x, digits, self._T_mu, self._T_m, self._comp, self.occ,
+            self.prof.n_limbs,
         )
-        # table x^0..x^15: (..., 16, n)
-        rows = [self.one_like(x), x]
-        for _ in range(14):
-            rows.append(self.mulmod(rows[-1], x))
-        tbl = jnp.stack(rows, axis=-2)
 
-        def step(acc, d):
-            acc = self.sqrmod(self.sqrmod(self.sqrmod(self.sqrmod(acc))))
-            sel = jnp.take_along_axis(
-                tbl, d[..., None, None].astype(jnp.int32), axis=-2
-            )[..., 0, :]
-            return self.mulmod(acc, sel), None
+    def powmod(self, x: jnp.ndarray, ebits: jnp.ndarray) -> jnp.ndarray:
+        """x^e with per-element exponent bits (LSB-first), 4-bit windows."""
+        return _k_powmod(
+            x, ebits, self._T_mu, self._T_m, self._comp, self.occ,
+            self.prof.n_limbs,
+        )
 
-        acc0 = self.one_like(x)
-        acc, _ = lax.scan(step, acc0, jnp.moveaxis(digits, -1, 0))
-        return acc
-
-    @_jit_method(static_argnums=(0, 1))
     def powmod_fixed_base(self, base: int, ebits: jnp.ndarray) -> jnp.ndarray:
         """base^e mod m, python-int base, per-element exponent bits.
-        Host-precomputed comb tables base^(16^i · w): ONE mulmod per 4-bit
-        window — n_bits/4 mulmods total, the cheapest exponentiation here
-        (the ring-Pedersen commitment workhorse)."""
+        Host-precomputed comb tables base^(16^i * w): ONE mulmod per 4-bit
+        window (the ring-Pedersen commitment workhorse)."""
         n_bits = ebits.shape[-1]
         nw = -(-n_bits // 4)
         key = (base % self.modulus, nw)
         tbl = self._fb_tables.get(key)
         if tbl is None:
-            t = np.empty((nw, 16, self.prof.n_limbs), dtype=np.int32)
-            b16 = base % self.modulus
+            # incremental build: b_i = base^(16^i) by squaring, row entries
+            # by repeated multiply - O(nw*16) modmuls, not modexps
+            m = self.modulus
+            vals = []
+            b_i = base % m
             for i in range(nw):
-                e = 1
+                acc = 1
                 for w in range(16):
-                    t[i, w] = bn.to_limbs(
-                        pow(b16, w * (1 << (4 * i)), self.modulus), self.prof
-                    )
-                del e
-            tbl = jnp.asarray(t)
-            self._fb_tables[key] = tbl
-        if nw * 4 != n_bits:
-            ebits = jnp.pad(
-                ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * 4 - n_bits)]
+                    vals.append(acc)
+                    acc = acc * b_i % m
+                b_i = pow(b_i, 16, m)
+            tbl = jnp.asarray(
+                ints_to_limbs(vals, self.prof).reshape(
+                    nw, 16, self.prof.n_limbs
+                )
             )
-        w = ebits.reshape(ebits.shape[:-1] + (nw, 4))
-        digits = (w * jnp.asarray([1, 2, 4, 8], jnp.int32)).sum(-1)
-
-        def step(acc, sl):
-            d, rows = sl  # d (...,), rows (16, n)
-            sel = rows[d]  # batched gather from 16 constants
-            return self.mulmod(acc, sel), None
-
-        acc0 = self.one_like(ebits)
-        acc, _ = lax.scan(
-            step, acc0, (jnp.moveaxis(digits, -1, 0), tbl)
+            self._fb_tables[key] = tbl
+        return _k_powmod_fb(
+            tbl, ebits, self._T_mu, self._T_m, self._comp, self.occ,
+            self.prof.n_limbs,
         )
-        return acc
 
     def invmod_prime(self, x: jnp.ndarray) -> jnp.ndarray:
         return self.powmod_const_exp(x, self.modulus - 2)
@@ -408,7 +484,7 @@ class MXUBarrett:
     # -- batch product reduction (for randomized batch verification) --------
 
     def prod_over_batch(self, x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
-        """Π x_b mod m along ``axis`` by log-depth pairwise folding."""
+        """Product of x_b mod m along ``axis`` by log-depth pairwise folds."""
         x = jnp.moveaxis(x, axis, 0)
         while x.shape[0] > 1:
             k = x.shape[0]

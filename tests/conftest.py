@@ -22,6 +22,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: the crypto kernels are scan-heavy and this host
+# has one core — caching compiled executables across runs/processes turns
+# minutes of XLA time into milliseconds
+jax.config.update("jax_compilation_cache_dir", 
+                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -10,6 +10,11 @@ import secrets
 import numpy as np
 import pytest
 
+# the whole module is slow-tier: even the shrunk 1024-bit fixture needs
+# minutes of kernel compiles on a cold cache (smoke tier must stay <60s);
+# GG18 engine coverage therefore lives in the slow tier + bench + dryrun
+pytestmark = pytest.mark.slow
+
 from mpcium_tpu.core import hostmath as hm
 from mpcium_tpu.core import paillier as pl
 from mpcium_tpu.engine import gg18_batch as gb
@@ -19,14 +24,61 @@ TEST_DOM = gb.Domains(alpha=600, beta_prime=320, gamma_bob=600)
 
 @pytest.fixture(scope="module")
 def small_preparams():
-    out = {}
-    for pid in ("node0", "node1"):
-        P = pl.gen_safe_prime(512)
-        Qp = pl.gen_safe_prime(512)
-        while Qp == P:
-            Qp = pl.gen_safe_prime(512)
-        out[pid] = pl.gen_preparams(bits=1024, safe_primes=(P, Qp))
-    return out
+    # committed FIXED keys: the persistent XLA cache stays valid across
+    # runs (fresh random moduli would recompile every kernel)
+    from mpcium_tpu.cluster import load_test_preparams
+
+    return load_test_preparams(bits=1024)
+
+
+def test_batched_gg18_3of5(small_preparams):
+    """t+1-of-n beyond two parties: a 3-signer quorum out of a 5-party
+    universe, all ordered MtA pairs (reference signs with any t+1 quorum,
+    ecdsa_signing_session.go:96-139)."""
+    B = 2  # same batch shape as the 2-party test: kernel cache is shared
+    universe = [f"node{i}" for i in range(5)]
+    shares = gb.dealer_keygen_secp_batch(B, universe, threshold=2)
+    quorum = ["node0", "node2", "node4"]
+    qshares = [shares[0], shares[2], shares[4]]
+    signer = gb.GG18BatchCoSigners(
+        quorum, qshares, small_preparams, dom=TEST_DOM
+    )
+    digests = np.frombuffer(secrets.token_bytes(B * 32), dtype=np.uint8).reshape(
+        B, 32
+    )
+    out = signer.sign(digests)
+    assert out["ok"].all(), "3-of-5 batched GG18 produced invalid signatures"
+    for i in range(B):
+        pub = hm.secp_decompress(shares[0][i].public_key)
+        r = int.from_bytes(out["r"][i].tobytes(), "big")
+        s = int.from_bytes(out["s"][i].tobytes(), "big")
+        digest = int.from_bytes(digests[i].tobytes(), "big")
+        assert hm.ecdsa_verify(pub, digest, r, s)
+
+
+def test_gg18_full_size():
+    """One batched 2-of-3 sign at FULL key size (2048-bit Paillier,
+    default GG18 exponent domains) — the bench configuration at B=2.
+    Slow-marked: minutes on a CPU host."""
+    from mpcium_tpu.cluster import load_test_preparams
+
+    B = 2
+    universe = ["node0", "node1", "node2"]
+    shares = gb.dealer_keygen_secp_batch(B, universe, threshold=1)
+    signer = gb.GG18BatchCoSigners(
+        ["node0", "node1"], shares[:2], load_test_preparams()
+    )
+    digests = np.frombuffer(secrets.token_bytes(B * 32), dtype=np.uint8).reshape(
+        B, 32
+    )
+    out = signer.sign(digests)
+    assert out["ok"].all(), "full-size batched GG18 produced invalid signatures"
+    for i in range(B):
+        pub = hm.secp_decompress(shares[0][i].public_key)
+        r = int.from_bytes(out["r"][i].tobytes(), "big")
+        s = int.from_bytes(out["s"][i].tobytes(), "big")
+        digest = int.from_bytes(digests[i].tobytes(), "big")
+        assert hm.ecdsa_verify(pub, digest, r, s)
 
 
 def test_batched_gg18_end_to_end(small_preparams):
