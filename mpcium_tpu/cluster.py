@@ -41,6 +41,8 @@ class LocalCluster:
         min_paillier_bits: int = 2046,
         reply_timeout_s: float = 30.0,
         transport: str = "loopback",  # "loopback" | "tcp"
+        batch_signing: bool = False,
+        batch_window_s: float = 0.05,
     ):
         from .config import init_config
 
@@ -96,7 +98,11 @@ class LocalCluster:
                 min_paillier_bits=min_paillier_bits,
             )
             self.nodes[nid] = node
-            ec = EventConsumer(node, transport)
+            ec = EventConsumer(
+                node, transport,
+                batch_signing=batch_signing,
+                batch_window_s=batch_window_s,
+            )
             ec.run()
             self.consumers.append(ec)
             sc = SigningConsumer(transport, reply_timeout_s=reply_timeout_s)
@@ -189,9 +195,13 @@ class LocalCluster:
             self.broker.close()
 
 
-def load_test_preparams() -> Dict[str, PreParams]:
-    """The committed 2048-bit fixtures (TEST/BENCH ONLY — production nodes
-    generate fresh pre-params, reference node.go:69)."""
-    data_path = Path(__file__).resolve().parent / "data" / "test_preparams.json"
+def load_test_preparams(bits: int = 2048) -> Dict[str, PreParams]:
+    """The committed fixtures (TEST/BENCH ONLY — production nodes generate
+    fresh pre-params, reference node.go:69). ``bits=1024`` selects the
+    shrunk-key fixture used by fast unit tests: FIXED keys also keep the
+    persistent XLA compile cache valid across runs (fresh random moduli
+    would embed different constants into every kernel)."""
+    name = "test_preparams.json" if bits == 2048 else f"test_preparams_{bits}.json"
+    data_path = Path(__file__).resolve().parent / "data" / name
     d = json.load(open(data_path))["preparams"]
     return {k: PreParams.from_json(v) for k, v in d.items()}

@@ -1,0 +1,388 @@
+"""The TPU batch scheduler: coalesce concurrent signing requests into
+fixed-shape engine dispatches (SURVEY.md §7.2 step 5).
+
+The reference spawns one goroutine-backed session per signing request
+(event_consumer.go:295-338); here concurrent ed25519 requests are BUCKETED
+by (participant set, threshold, epoch), padded into one batch, and signed
+by ONE protocol instance whose per-round compute is one engine dispatch
+(protocol.eddsa.batch_signing). Per-session results demux back through the
+normal result queues / reply inboxes.
+
+Batch composition must be identical on every quorum member, so one member
+is the MANIFEST LEADER — deterministically the lexicographically-smallest
+participant (static: no election, no races). The leader buffers requests
+for ``window_s`` (or until ``max_batch``), then broadcasts a manifest
+listing the batch, **signed with its node identity**; receivers verify
+both the leader signature and — because the leader is otherwise untrusted
+for content — every entry's ORIGINAL initiator signature. Followers buffer
+their requests purely as a liveness fallback: if no manifest covers a
+request within ``manifest_timeout_s`` (leader down), it falls back to the
+per-session signing path (one bucket-level timer, not one per request).
+
+secp256k1 note: GG18's batched engine (engine.gg18_batch) currently runs
+as an in-process fabric (bench/measurement); its distributed per-party
+round exchange is future work, so ECDSA requests take the per-session
+path. The scheduler's bucketing/manifest machinery is curve-agnostic.
+"""
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import wire
+from ..node.node import Node, NotEnoughParticipants
+from ..node.session import Session
+from ..protocol.base import KeygenShare, ProtocolError
+from ..protocol.eddsa.batch_signing import BatchedEDDSASigningParty
+from ..transport.api import Transport
+from ..utils import log
+
+
+@dataclass
+class _Entry:
+    msg: wire.SignTxMessage
+    reply_topic: str
+    added_at: float = field(default_factory=time.monotonic)
+
+
+def _bucket_key(info) -> Tuple:
+    return (tuple(info.participant_peer_ids), info.threshold, info.epoch)
+
+
+def _manifest_body(batch_id: str, leader: str, requests: List[dict]) -> bytes:
+    return wire.canonical_json(
+        {"batch_id": batch_id, "leader": leader, "requests": requests}
+    )
+
+
+class BatchSigningScheduler:
+    """Per-node scheduler instance (every node runs one)."""
+
+    def __init__(
+        self,
+        node: Node,
+        transport: Transport,
+        window_s: float = 0.05,
+        max_batch: int = 1024,
+        manifest_timeout_s: float = 2.0,
+        on_fallback: Optional[Callable[[wire.SignTxMessage, str], None]] = None,
+        on_tx_done: Optional[Callable[[str, str], None]] = None,
+        on_tx_released: Optional[Callable[[str, str], None]] = None,
+        claim_tx: Optional[Callable[[str, str], bool]] = None,
+    ):
+        self.node = node
+        self.transport = transport
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.manifest_timeout_s = manifest_timeout_s
+        self.on_fallback = on_fallback  # per-session path (consumer wires it)
+        # lifecycle callbacks into the consumer's dedup bookkeeping
+        self.on_tx_done = on_tx_done or (lambda w, t: None)
+        self.on_tx_released = on_tx_released or (lambda w, t: None)
+        self.claim_tx = claim_tx or (lambda w, t: True)
+        self._lock = threading.RLock()
+        self._buckets: Dict[Tuple, List[_Entry]] = {}
+        self._timers: Dict[Tuple, threading.Timer] = {}  # leader windows +
+        # follower fallbacks, keyed ("win"|"fb", bucket)
+        self._sessions: List[Session] = []
+        self.batches_run = 0  # engine-dispatch diagnostic (tests assert ≪ N)
+        self._sub = transport.pubsub.subscribe(
+            wire.TOPIC_BATCH_MANIFEST, self._on_manifest_raw
+        )
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+        self._sub.unsubscribe()
+        with self._lock:
+            for t in self._timers.values():
+                t.cancel()
+            self._timers.clear()
+            for s in self._sessions:
+                s.close()
+
+    # -- request intake ------------------------------------------------------
+
+    def submit(self, msg: wire.SignTxMessage, reply_topic: str) -> bool:
+        """Buffer a verified signing request for batching. Returns False if
+        the request cannot be batched (caller should use the per-session
+        path). The caller holds the dedup claim for this tx."""
+        if msg.key_type != wire.KEY_TYPE_ED25519:
+            return False
+        info = self.node.keyinfo.get(msg.key_type, msg.wallet_id)
+        if info is None:
+            return False
+        key = _bucket_key(info)
+        leader = sorted(info.participant_peer_ids)[0]
+        entry = _Entry(msg, reply_topic)
+        with self._lock:
+            if self._closed:
+                return False
+            self._buckets.setdefault(key, []).append(entry)
+            if self.node.node_id == leader:
+                if len(self._buckets[key]) >= self.max_batch:
+                    self._fire(key)
+                elif ("win", key) not in self._timers:
+                    t = threading.Timer(self.window_s, self._fire, (key,))
+                    t.daemon = True
+                    t.start()
+                    self._timers[("win", key)] = t
+            elif ("fb", key) not in self._timers:
+                # follower: ONE bucket-level liveness timer (re-armed while
+                # entries remain), not one thread per request
+                t = threading.Timer(
+                    self.manifest_timeout_s, self._fallback_sweep, (key,)
+                )
+                t.daemon = True
+                t.start()
+                self._timers[("fb", key)] = t
+        return True
+
+    # -- leader: manifest emission ------------------------------------------
+
+    def _fire(self, key: Tuple) -> None:
+        with self._lock:
+            t = self._timers.pop(("win", key), None)
+            if t:
+                t.cancel()
+            entries = self._buckets.pop(key, [])
+        if not entries:
+            return
+        batch_id = secrets.token_hex(8)
+        requests = [
+            {"msg": e.msg.to_json(), "reply": e.reply_topic} for e in entries
+        ]
+        body = _manifest_body(batch_id, self.node.node_id, requests)
+        manifest = {
+            "batch_id": batch_id,
+            "leader": self.node.node_id,
+            "requests": requests,
+            "sig": self.node.identity.sign_raw(body).hex(),
+        }
+        self.transport.pubsub.publish(
+            wire.TOPIC_BATCH_MANIFEST, json.dumps(manifest).encode()
+        )
+
+    def _fallback_sweep(self, key: Tuple) -> None:
+        """Follower liveness: entries the leader never covered go down the
+        per-session path; re-arm while the bucket stays non-empty."""
+        now = time.monotonic()
+        stale: List[_Entry] = []
+        with self._lock:
+            self._timers.pop(("fb", key), None)
+            if self._closed:
+                return
+            bucket = self._buckets.get(key, [])
+            stale = [
+                e for e in bucket
+                if now - e.added_at >= self.manifest_timeout_s
+            ]
+            bucket[:] = [e for e in bucket if e not in stale]
+            if bucket:
+                t = threading.Timer(
+                    self.manifest_timeout_s, self._fallback_sweep, (key,)
+                )
+                t.daemon = True
+                t.start()
+                self._timers[("fb", key)] = t
+        for e in stale:
+            log.warn("batch manifest timeout — per-session fallback",
+                     wallet=e.msg.wallet_id, tx=e.msg.tx_id,
+                     node=self.node.node_id)
+            if self.on_fallback:
+                self.on_fallback(e.msg, e.reply_topic)
+
+    # -- all quorum members: manifest execution ------------------------------
+
+    def _on_manifest_raw(self, raw: bytes) -> None:
+        try:
+            man = json.loads(raw)
+            batch_id = man["batch_id"]
+            leader = man["leader"]
+            sig = bytes.fromhex(man["sig"])
+            requests = man["requests"]
+            reqs = [
+                (wire.SignTxMessage.from_json(r["msg"]), r.get("reply", ""))
+                for r in requests
+            ]
+        except Exception as e:  # noqa: BLE001
+            log.warn("bad batch manifest dropped", error=repr(e))
+            return
+        if not reqs:
+            return
+        # leader authenticity: must be signed by the node it claims to be
+        # from, and that node must be the deterministic leader for the
+        # wallets' topology (checked against OUR keyinfo below)
+        body = _manifest_body(batch_id, leader, requests)
+        if not self.node.identity.verify_peer(leader, body, sig):
+            log.warn("batch manifest with BAD leader signature dropped",
+                     batch=batch_id)
+            return
+        info = self.node.keyinfo.get(reqs[0][0].key_type, reqs[0][0].wallet_id)
+        if info is None or sorted(info.participant_peer_ids)[0] != leader:
+            log.warn("batch manifest from non-leader dropped",
+                     batch=batch_id, claimed=leader)
+            return
+        # batch homogeneity: the leader is untrusted — every request must be
+        # ed25519 and share the (participants, threshold, epoch) bucket of
+        # the first (otherwise a leader for ONE wallet could smuggle foreign
+        # topologies/curves into followers' batches)
+        want = _bucket_key(info)
+        for msg, _reply in reqs:
+            if msg.key_type != wire.KEY_TYPE_ED25519:
+                log.warn("non-ed25519 request in manifest dropped",
+                         batch=batch_id)
+                return
+            winfo = self.node.keyinfo.get(msg.key_type, msg.wallet_id)
+            if winfo is None or _bucket_key(winfo) != want:
+                log.warn("mixed-topology batch manifest dropped",
+                         batch=batch_id, wallet=msg.wallet_id)
+                return
+        # the leader is untrusted for content: re-verify every initiator
+        # signature
+        for msg, _reply in reqs:
+            if not self.node.identity.verify_initiator(msg.raw(), msg.signature):
+                log.warn("batch manifest with BAD initiator signature dropped",
+                         batch=batch_id)
+                return
+        # drop covered entries from local buffers BEFORE any early return,
+        # so follower fallback timers cannot race a manifest we act on
+        covered = {(m.wallet_id, m.tx_id) for m, _ in reqs}
+        with self._lock:
+            for bucket in self._buckets.values():
+                bucket[:] = [
+                    e for e in bucket
+                    if (e.msg.wallet_id, e.msg.tx_id) not in covered
+                ]
+        threading.Thread(
+            target=self._run_batch, args=(batch_id, reqs),
+            name=f"bsign-{batch_id}", daemon=True,
+        ).start()
+
+    def _run_batch(
+        self, batch_id: str, reqs: List[Tuple[wire.SignTxMessage, str]]
+    ) -> None:
+        node = self.node
+        first = reqs[0][0]
+        info = node.keyinfo.get(first.key_type, first.wallet_id)
+        if info is None:
+            return
+        # claim lanes we don't already own (e.g. the manifest beat the
+        # pub/sub copy of the request to this node). Claims held by the
+        # normal _on_sign path for these txs also count as ours: the
+        # consumer routed them to submit(), so the batch is their owner.
+        # Only claims WE acquire (or that _on_sign routed to submit(), i.e.
+        # already covered by a manifest) belong to the batch; a claim held
+        # by a live per-session run (manifest raced the fallback) must not
+        # be finished/released by us — that run owns its own lifecycle.
+        owned: List[Tuple[str, str]] = []
+        for msg, _r in reqs:
+            if self.claim_tx(msg.wallet_id, msg.tx_id):
+                owned.append((msg.wallet_id, msg.tx_id))
+
+        owned_set = set(owned)
+
+        def release_all():
+            for w, t in owned:
+                self.on_tx_released(w, t)
+
+        try:
+            quorum = node._ready_quorum(
+                info.participant_peer_ids, info.threshold + 1
+            )
+        except NotEnoughParticipants:
+            release_all()
+            return  # no reply ⇒ durable redelivery retries
+        if node.node_id not in quorum:
+            release_all()
+            return
+        shares: List[KeygenShare] = []
+        messages: List[bytes] = []
+        try:
+            for msg, _r in reqs:
+                share = node.load_share(msg.key_type, msg.wallet_id)
+                winfo = node.keyinfo.get(msg.key_type, msg.wallet_id)
+                if winfo is None or share.epoch != winfo.epoch:
+                    raise NotEnoughParticipants("epoch fence (mid-reshare)")
+                shares.append(share)
+                messages.append(msg.tx)
+            party = BatchedEDDSASigningParty(
+                f"bsign:{batch_id}", node.node_id, quorum, shares, messages
+            )
+        except (ProtocolError, NotEnoughParticipants) as e:
+            log.warn("batch not signable here — waiting for redelivery",
+                     batch=batch_id, reason=str(e), node=node.node_id)
+            release_all()
+            return
+
+        def on_done(result):
+            sigs, ok = result["signatures"], result["ok"]
+            for i, (msg, reply) in enumerate(reqs):
+                if bool(ok[i]):
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        signature=sigs[i].tobytes().hex(),
+                    )
+                else:
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_ERROR,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        error_reason="batched signature failed verification",
+                    )
+                self.transport.queues.enqueue(
+                    wire.TOPIC_SIGNING_RESULT,
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=msg.tx_id,
+                )
+                if reply:
+                    self.transport.pubsub.publish(
+                        reply, b"OK" if bool(ok[i]) else b"ERR"
+                    )
+                if (msg.wallet_id, msg.tx_id) in owned_set:
+                    self.on_tx_done(msg.wallet_id, msg.tx_id)
+            log.info("batch signed", batch=batch_id, size=len(reqs),
+                     node=node.node_id)
+            _prune()
+
+        def on_error(e):
+            # retryable/protocol failure: emit nothing — durable redelivery
+            # retries each request (possibly down the per-session path)
+            log.warn("batch signing failed", batch=batch_id, error=str(e),
+                     node=node.node_id)
+            release_all()
+            _prune()
+
+        def _prune():
+            with self._lock:
+                if session in self._sessions:
+                    self._sessions.remove(session)
+            session.close()
+
+        session = Session(
+            session_id=f"bsign:{batch_id}",
+            party=party,
+            node_id=node.node_id,
+            participants=quorum,
+            transport=self.transport,
+            identity=node.identity,
+            broadcast_topic=f"bsign:broadcast:{batch_id}",
+            direct_topic_fn=lambda n: f"bsign:direct:{n}:{batch_id}",
+            on_done=on_done,
+            on_error=on_error,
+        )
+        with self._lock:
+            if self._closed:
+                release_all()
+                return
+            self._sessions.append(session)
+            self.batches_run += 1
+        session.listen()

@@ -40,6 +40,8 @@ class EventConsumer:
         transport: Transport,
         session_timeout_s: float = SESSION_TIMEOUT_S,
         gc_interval_s: float = GC_INTERVAL_S,
+        batch_signing: bool = False,
+        batch_window_s: float = 0.05,
     ):
         self.node = node
         self.transport = transport
@@ -50,6 +52,17 @@ class EventConsumer:
         self._subs = []
         self._gc_stop = threading.Event()
         self._gc_thread: Optional[threading.Thread] = None
+        self.scheduler = None
+        if batch_signing:
+            from .batch_scheduler import BatchSigningScheduler
+
+            self.scheduler = BatchSigningScheduler(
+                node, transport, window_s=batch_window_s,
+                on_fallback=self._batch_fallback,
+                on_tx_done=lambda w, t: self._finish(f"{w}-{t}"),
+                on_tx_released=lambda w, t: self._release(f"{w}-{t}"),
+                claim_tx=lambda w, t: self._claim(f"{w}-{t}"),
+            )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -65,6 +78,8 @@ class EventConsumer:
 
     def close(self) -> None:
         self._gc_stop.set()
+        if self.scheduler is not None:
+            self.scheduler.close()
         for s in self._subs:
             s.unsubscribe()
         with self._lock:
@@ -195,7 +210,22 @@ class EventConsumer:
         if not self._claim(dedup):
             log.info("duplicate signing session ignored", key=dedup)
             return
+        # TPU batch path: coalesce concurrent requests into one engine
+        # dispatch per round (consumers.batch_scheduler); falls back to the
+        # per-session path when batching does not apply
+        if self.scheduler is not None and self.scheduler.submit(
+            msg, reply_topic
+        ):
+            return
+        self._start_single(msg, reply_topic, dedup)
 
+    def _batch_fallback(self, msg, reply_topic) -> None:
+        """Scheduler liveness fallback (manifest never arrived): run the
+        request through the normal per-session path. The dedup claim from
+        _on_sign is still held."""
+        self._start_single(msg, reply_topic, f"{msg.wallet_id}-{msg.tx_id}")
+
+    def _start_single(self, msg, reply_topic: str, dedup: str) -> None:
         def emit_error(reason: str, timeout: bool = False):
             ev = wire.SigningResultEvent(
                 result_type=wire.RESULT_ERROR,

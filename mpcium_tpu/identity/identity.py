@@ -198,6 +198,21 @@ class IdentityStore:
         except InvalidSignature:
             return False
 
+    # -- raw message auth (batch manifests etc.) ----------------------------
+
+    def sign_raw(self, raw: bytes) -> bytes:
+        return self._sk.sign(raw)
+
+    def verify_peer(self, node_id: str, raw: bytes, signature: bytes) -> bool:
+        pub = self._pub.get(node_id)
+        if pub is None or not signature:
+            return False
+        try:
+            pub.verify(signature, raw)
+            return True
+        except InvalidSignature:
+            return False
+
     # -- initiator auth -----------------------------------------------------
 
     def verify_initiator(self, raw: bytes, signature: bytes) -> bool:

@@ -1,0 +1,194 @@
+"""Distributed batched threshold-Ed25519 signing: ONE protocol instance
+signs B wallets' digests concurrently.
+
+This is the node-side face of the TPU batch engine (SURVEY.md §7.2 step 5):
+where :mod:`.signing` runs one session per wallet (per-session goroutine
+concurrency in the reference, event_consumer.go:295-338), this party
+exchanges fixed-shape BYTE BLOCKS — (B·32)-byte commitment/nonce/partial
+blocks — and computes each round with one :mod:`engine.eddsa_batch`
+dispatch. The scheduler (consumers.batch_scheduler) buckets concurrent
+signing requests into these batches.
+
+Protocol (same 3-round commit–reveal threshold Schnorr as .signing, over
+the batch):
+
+  R1 (broadcast) hash commitment to this party's (B, 32) nonce block
+  R2 (broadcast) decommit: nonce block + blind
+  R3 (broadcast) partial-signature block (B, 32)
+  finalize       combine + batched RFC 8032 verification → per-session ok
+
+A failed session (bad point, verification miss) fails ONLY its lane: the
+result carries a per-session ok mask so the scheduler can emit per-tx
+success/error events. Commitment fraud aborts the whole batch with the
+culprit attributed (same abort semantics as the per-session protocol).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import bignum as bn
+from ...core import hostmath as hm
+from ...engine import eddsa_batch as eb
+from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
+
+R1_COMMIT = "eddsa/bsign/1/commit"
+R2_REVEAL = "eddsa/bsign/2/reveal"
+R3_PARTIAL = "eddsa/bsign/3/partial"
+
+
+def _block_commit(blind: bytes, block: bytes, bind: bytes) -> str:
+    return hashlib.sha256(
+        b"mpcium-tpu/bsign/" + bind + blind + block
+    ).hexdigest()
+
+
+class BatchedEDDSASigningParty(PartyBase):
+    """One signer's side of a B-session batch.
+
+    ``shares``: this node's key shares, one per wallet (batch order is the
+    manifest order, identical on every quorum member). ``messages``: the
+    B digests/transactions to sign. All wallets must share the signing
+    quorum (``party_ids``); universes may differ per wallet (λ is computed
+    per wallet from its own keygen universe).
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        self_id: str,
+        party_ids: Sequence[str],
+        shares: Sequence[KeygenShare],
+        messages: Sequence[bytes],
+        rng=None,
+    ):
+        import secrets as _secrets
+
+        super().__init__(session_id, self_id, party_ids, rng or _secrets)
+        if len(shares) != len(messages) or not shares:
+            raise ValueError("one share per message required")
+        self.B = len(shares)
+        self.messages = [bytes(m) for m in messages]
+        lamx = []
+        for s in shares:
+            if s.key_type != "ed25519":
+                raise ProtocolError("wrong key type for EdDSA batch signing")
+            if len(party_ids) < s.threshold + 1:
+                raise ProtocolError("not enough participants for threshold")
+            xs = party_xs(s.participants)
+            for pid in party_ids:
+                if pid not in xs:
+                    raise ProtocolError("signer not in keygen universe", pid)
+            if xs[self_id] != s.self_x:
+                raise ProtocolError("share does not belong to this node")
+            quorum_xs = [xs[p] for p in self.party_ids]
+            lam = hm.lagrange_coeff(quorum_xs, xs[self_id], hm.ED_L)
+            lamx.append(lam * s.share % hm.ED_L)
+        self.lamx = eb.scalars_to_limb_batch(lamx)
+        self.A_comp = np.stack(
+            [np.frombuffer(s.public_key, dtype=np.uint8) for s in shares]
+        )
+        self._stage = 0
+
+    # -- rounds --------------------------------------------------------------
+
+    def _bind(self) -> bytes:
+        return f"{self.session_id}:{self.self_id}".encode()
+
+    def start(self) -> List[RoundMsg]:
+        r64 = eb.fresh_nonce_bytes(self.B, self.rng)
+        self._r_limbs, R_comp = eb.nonce_commitments(jnp.asarray(r64))
+        self._R_block = np.asarray(R_comp).tobytes()  # B·32 bytes
+        self._blind = self.rng.token_bytes(32)
+        commit = _block_commit(self._blind, self._R_block, self._bind())
+        self._stage = 1
+        return [self.broadcast(R1_COMMIT, {"commit": commit})]
+
+    def receive(self, msg: RoundMsg) -> List[RoundMsg]:
+        if self.done:
+            return []
+        self._store(msg)
+        others = self.others()
+        out: List[RoundMsg] = []
+        if self._stage == 1 and self._round_full(R1_COMMIT, others):
+            out.append(
+                self.broadcast(
+                    R2_REVEAL,
+                    {"R": self._R_block.hex(), "blind": self._blind.hex()},
+                )
+            )
+            self._stage = 2
+        if self._stage == 2 and self._round_full(R2_REVEAL, others):
+            out.append(self._round3())
+            self._stage = 3
+        if self._stage == 3 and self._round_full(R3_PARTIAL, others):
+            self._finalize()
+        return out
+
+    def _peer_blocks(self, round_name: str, field: str, nbytes: int) -> Dict[str, bytes]:
+        payloads = self._round_payloads(round_name)
+        out = {}
+        for pid, p in payloads.items():
+            b = bytes.fromhex(p[field])
+            if len(b) != nbytes:
+                raise ProtocolError(f"bad {field} block size", pid)
+            out[pid] = b
+        return out
+
+    def _round3(self) -> RoundMsg:
+        commits = self._round_payloads(R1_COMMIT)
+        reveals = self._round_payloads(R2_REVEAL)
+        R_blocks: List[bytes] = []
+        for pid in self.party_ids:
+            if pid == self.self_id:
+                R_blocks.append(self._R_block)
+                continue
+            blk = bytes.fromhex(reveals[pid]["R"])
+            if len(blk) != self.B * 32:
+                raise ProtocolError("bad nonce block size", pid)
+            bind = f"{self.session_id}:{pid}".encode()
+            if (
+                _block_commit(bytes.fromhex(reveals[pid]["blind"]), blk, bind)
+                != commits[pid]["commit"]
+            ):
+                raise ProtocolError("nonce commitment fraud", pid)
+            R_blocks.append(blk)
+        R_all = np.stack(
+            [np.frombuffer(b, dtype=np.uint8).reshape(self.B, 32) for b in R_blocks]
+        )
+        R_sum, ok_R = eb.aggregate_nonce(jnp.asarray(R_all))
+        self._R_sum = np.asarray(R_sum)
+        self._ok_R = np.asarray(ok_R)
+        self._c64 = eb.challenge_hashes(self._R_sum, self.A_comp, self.messages)
+        parts = eb.partial_signature(
+            self._r_limbs, jnp.asarray(self._c64), jnp.asarray(self.lamx)
+        )
+        s_block = np.asarray(
+            bn.limbs_to_bytes_le(parts, bn.P256, 32)
+        )
+        self._parts = parts
+        return self.broadcast(R3_PARTIAL, {"s": s_block.tobytes().hex()})
+
+    def _finalize(self) -> None:
+        blocks = self._peer_blocks(R3_PARTIAL, "s", self.B * 32)
+        stacked = [self._parts]
+        for pid in self.party_ids:
+            if pid == self.self_id:
+                continue
+            arr = np.frombuffer(blocks[pid], dtype=np.uint8).reshape(self.B, 32)
+            stacked.append(
+                bn.bytes_to_limbs_le(jnp.asarray(arr), bn.P256, bn.P256.n_limbs)
+            )
+        parts = jnp.stack(stacked)
+        sigs, _s = eb.combine_signatures(parts, jnp.asarray(self._R_sum))
+        ok = eb.verify_signatures(
+            sigs, jnp.asarray(self.A_comp), jnp.asarray(self._c64)
+        )
+        self.result = {
+            "signatures": np.asarray(sigs),
+            "ok": np.asarray(ok) & self._ok_R,
+        }
+        self.done = True

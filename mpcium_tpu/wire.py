@@ -332,6 +332,9 @@ TOPIC_SIGNING_REQUEST = "mpc.signing_request.event"
 TOPIC_KEYGEN_RESULT = "mpc.mpc_keygen_success"
 TOPIC_SIGNING_RESULT = "mpc.signing_result.complete"
 TOPIC_RESHARING_RESULT = "mpc.mpc_resharing_success"
+# batched-signing manifest fan-out (TPU batch scheduler; no reference
+# analogue - the reference runs one goroutine per session)
+TOPIC_BATCH_MANIFEST = "mpc:batch_manifest"
 
 
 def keygen_broadcast_topic(key_type: str, wallet_id: str) -> str:

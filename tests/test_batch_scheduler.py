@@ -1,0 +1,129 @@
+"""Batch scheduler: N concurrent signing requests → far fewer engine
+dispatches, each client still gets its own result (SURVEY.md §7.2 step 5;
+replaces the reference's per-session goroutines, event_consumer.go:295-338).
+"""
+import secrets
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from mpcium_tpu import wire
+from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.engine import eddsa_batch as eb
+from mpcium_tpu.protocol.eddsa.keygen import EDDSAKeygenParty
+from mpcium_tpu.protocol.runner import run_protocol
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = LocalCluster(
+        n_nodes=3,
+        threshold=1,
+        root_dir=str(tmp_path_factory.mktemp("bsched")),
+        preparams=load_test_preparams(),  # committed fixture: no prime search
+        batch_signing=True,
+        batch_window_s=0.25,
+        reply_timeout_s=30.0,
+    )
+    # deal EdDSA wallets straight into the stores (fast; DKG covered
+    # elsewhere)
+    ids = c.node_ids
+    n_wallets = 12
+    shares = eb.dealer_keygen_batch(n_wallets, ids, threshold=1)
+    pubs = []
+    for w in range(n_wallets):
+        for i, nid in enumerate(ids):
+            c.nodes[nid].save_share(shares[i][w], f"bw{w}")
+        pubs.append(shares[0][w].public_key)
+    c._test_pubs = pubs
+    yield c
+    c.close()
+
+
+def test_batched_signing_coalesces(cluster):
+    """12 concurrent requests over 12 wallets: every tx gets its own valid
+    signature while the engine runs ≪ 12 batches."""
+    n = 12
+    results = {}
+    done = threading.Event()
+
+    def on_result(ev):
+        results[ev.tx_id] = ev
+        if len(results) == n:
+            done.set()
+
+    sub = cluster.client.on_sign_result(on_result)
+    txs = {}
+    try:
+        start_batches = sum(
+            ec.scheduler.batches_run for ec in cluster.consumers
+        )
+        for w in range(n):
+            tx = secrets.token_bytes(32)
+            tx_id = f"btx-{w}"
+            txs[tx_id] = (w, tx)
+            cluster.client.sign_transaction(
+                wire.SignTxMessage(
+                    key_type="ed25519",
+                    wallet_id=f"bw{w}",
+                    network_internal_code="sol",
+                    tx_id=tx_id,
+                    tx=tx,
+                )
+            )
+        assert done.wait(60), f"only {len(results)}/{n} results arrived"
+    finally:
+        sub.unsubscribe()
+
+    for tx_id, ev in results.items():
+        w, tx = txs[tx_id]
+        assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+        assert hm.ed25519_verify(
+            cluster._test_pubs[w], tx, bytes.fromhex(ev.signature)
+        ), f"invalid signature for {tx_id}"
+
+    # the point of the scheduler: dispatch count ≪ N. Per node, all 12
+    # requests should land in a handful of manifests (ideally 1-2 windows).
+    end_batches = sum(ec.scheduler.batches_run for ec in cluster.consumers)
+    per_node = (end_batches - start_batches) / len(cluster.consumers)
+    assert per_node <= 4, (
+        f"expected ≤4 batches per node for {n} concurrent txs, got {per_node}"
+    )
+
+
+def test_batch_preserves_wrong_key_isolation(cluster):
+    """A request for an unknown wallet dead-letters (timeout error event)
+    without poisoning concurrent valid batches."""
+    results = {}
+    done = threading.Event()
+
+    def on_result(ev):
+        results[ev.tx_id] = ev
+        if "good-tx" in results and "bad-tx" in results:
+            done.set()
+
+    sub = cluster.client.on_sign_result(on_result)
+    try:
+        cluster.client.sign_transaction(
+            wire.SignTxMessage(
+                key_type="ed25519", wallet_id="no-such-wallet",
+                network_internal_code="sol", tx_id="bad-tx",
+                tx=b"\x01" * 32,
+            )
+        )
+        tx = secrets.token_bytes(32)
+        cluster.client.sign_transaction(
+            wire.SignTxMessage(
+                key_type="ed25519", wallet_id="bw0",
+                network_internal_code="sol", tx_id="good-tx", tx=tx,
+            )
+        )
+        assert done.wait(120), f"results: {list(results)}"
+    finally:
+        sub.unsubscribe()
+    assert results["good-tx"].result_type == wire.RESULT_SUCCESS
+    bad = results["bad-tx"]
+    assert bad.result_type == wire.RESULT_ERROR and bad.is_timeout
