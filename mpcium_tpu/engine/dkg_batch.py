@@ -1,0 +1,330 @@
+"""Batched distributed key generation + resharing engines.
+
+BASELINE configs 4–5 (SURVEY.md §6): 4096-wallet DKG and 1024-wallet
+committee rotation. The reference runs one tss-lib keygen/resharing party
+per wallet (ecdsa_keygen_session.go:89-152, ecdsa_resharing_session.go:
+114-203); here B wallets' Feldman-VSS arithmetic runs as fused device
+dispatches per party per round:
+
+- polynomial sampling: (B, t+1) scalars per party;
+- Feldman commitments: batched fixed-base scalar-mults;
+- sub-shares f_i(x_j): Horner over the scalar ring (constant x_j);
+- sub-share verification: f_i(x_j)·G == Σ_k x_j^k·C_ik via point-Horner
+  (x_j is a tiny participant index ⇒ 8-bit ladders);
+- hash commit/reveal binding: device SHA-256 over compressed-point blocks.
+
+For secp256k1 the per-NODE Paillier/ring-Pedersen material (preparams) is
+independent of the wallet batch — generated once at startup (reference
+node.go:69) and attached outside this engine — so ECDSA and EdDSA DKG
+share the same batched curve core.
+
+In-process fabric (like eddsa_batch.BatchedCoSigners / GG18BatchCoSigners):
+computes every party's side for bench/tests; the distributed node runs the
+same kernels per party.
+"""
+from __future__ import annotations
+
+import functools
+import secrets
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import bignum as bn
+from ..core import ed25519_jax as ed
+from ..core import hostmath as hm
+from ..core import secp256k1_jax as sp
+from ..core.bignum import P256
+from ..ops.sha256 import sha256 as dev_sha256
+from ..protocol.base import KeygenShare, party_xs
+
+SCALAR_BITS = 256
+
+
+def _curve(key_type: str):
+    if key_type == "ed25519":
+        return ed, hm.ED_L
+    if key_type == "secp256k1":
+        return sp, hm.SECP_N
+    raise ValueError(key_type)
+
+
+def _compress_host(key_type: str, pt) -> List[bytes]:
+    mod, _ = _curve(key_type)
+    return [bytes(c) for c in np.asarray(mod.compress(pt))]
+
+
+def _rand_scalars(shape: Tuple[int, ...], order: int, rng) -> np.ndarray:
+    """Uniform scalars mod order as limb tensors (wide-reduction)."""
+    flat = int(np.prod(shape))
+    vals = [
+        int.from_bytes(rng.token_bytes(40), "little") % order
+        for _ in range(flat)
+    ]
+    return bn.batch_to_limbs(vals, P256).reshape(*shape, P256.n_limbs)
+
+
+@functools.partial(jax.jit, static_argnames=("key_type",))
+def _commit_phase(coeffs: jnp.ndarray, blinds: jnp.ndarray, key_type: str):
+    """coeffs (q, t+1, B, 22) → (commitment points [per party: list over k],
+    compressed blocks (q, t+1, B, 32), hash commitments (q, B, 32))."""
+    mod, _ = _curve(key_type)
+    q, tp1 = coeffs.shape[0], coeffs.shape[1]
+    pts, comps, commits = [], [], []
+    for i in range(q):
+        row_pts, row_comps = [], []
+        for kdeg in range(tp1):
+            pt = mod.base_mul(
+                bn.limbs_to_bits(coeffs[i, kdeg], P256, SCALAR_BITS)
+            )
+            row_pts.append(pt)
+            row_comps.append(mod.compress(pt))
+        pts.append(row_pts)
+        block = jnp.concatenate(row_comps, axis=-1)  # (B, 32·(t+1))
+        tag = np.frombuffer(b"mpcium-tpu/dkg/%d" % i, dtype=np.uint8)
+        tag_t = jnp.broadcast_to(jnp.asarray(tag), block.shape[:-1] + tag.shape)
+        commits.append(
+            dev_sha256(jnp.concatenate([tag_t, blinds[i], block], axis=-1))
+        )
+        comps.append(jnp.stack(row_comps))
+    return pts, jnp.stack(comps), jnp.stack(commits)
+
+
+@functools.partial(jax.jit, static_argnames=("key_type", "xs"))
+def _subshare_phase(coeffs: jnp.ndarray, key_type: str, xs: Tuple[int, ...]):
+    """f_i(x_j) for every (party i, recipient j): (q, n_recv, B, 22)."""
+    _, order = _curve(key_type)
+    ring = (sp if key_type == "secp256k1" else ed).scalar_ring()
+    q, tp1, B = coeffs.shape[0], coeffs.shape[1], coeffs.shape[2]
+    out = []
+    for i in range(q):
+        row = []
+        for xj in xs:
+            acc = coeffs[i, tp1 - 1]
+            xl = ring.const(xj, (B,))
+            for kdeg in range(tp1 - 2, -1, -1):
+                acc = ring.addmod(ring.mulmod(acc, xl), coeffs[i, kdeg])
+            row.append(acc)
+        out.append(jnp.stack(row))
+    return jnp.stack(out)
+
+
+def _xj_bits(xj: int, B: int) -> jnp.ndarray:
+    """Participant x-coordinate as an 8-bit operand row (indices are tiny)."""
+    assert xj.bit_length() <= 8
+    return jnp.broadcast_to(
+        jnp.asarray([(xj >> b) & 1 for b in range(8)], jnp.int32), (B, 8)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("key_type",))
+def _blk_vss_check(subshare, pts_desc, xbits, key_type: str):
+    """One (dealer i, recipient j) Feldman check: f_i(x_j)·G == Σ x^k·C_ik.
+    Point-Horner with the tiny x as an OPERAND — one compiled block reused
+    for every (i, j) pair (monolithic verify executables crashed XLA's
+    CPU serializer; block granularity also caches better)."""
+    mod, _ = _curve(key_type)
+    lhs = mod.base_mul(bn.limbs_to_bits(subshare, P256, SCALAR_BITS))
+    acc = pts_desc[0]
+    for pt in pts_desc[1:]:
+        acc = mod.add(mod.scalar_mul(xbits, acc), pt)
+    return mod.equal(lhs, acc)
+
+
+def _verify_phase_points(subshares, pts, key_type: str, xs):
+    """VSS check against in-process commitment POINTS (both curves)."""
+    q = len(pts)
+    B = subshares.shape[2]
+    ok = jnp.ones((B,), bool)
+    for i in range(q):
+        pts_desc = tuple(pts[i][::-1])
+        for j, xj in enumerate(xs):
+            ok = ok & _blk_vss_check(
+                subshares[i, j], pts_desc, _xj_bits(xj, B), key_type
+            )
+    return ok
+
+
+class BatchedDKG:
+    """In-process q-party Feldman DKG for B wallets (bench/test fabric —
+    the distributed node runs one side of the same kernels per party)."""
+
+    def __init__(
+        self,
+        party_ids: Sequence[str],
+        threshold: int,
+        key_type: str,
+        rng=secrets,
+    ):
+        # preserve caller order: run()'s result[i] belongs to party_ids[i]
+        self.ids = list(party_ids)
+        self.t = threshold
+        self.key_type = key_type
+        self.rng = rng
+        if not 0 < threshold < len(self.ids):
+            raise ValueError("need 0 < t < n")
+        self.xs = party_xs(self.ids)
+
+    def run(self, n_wallets: int) -> List[List[KeygenShare]]:
+        """Returns per-party share lists (result[i] → party_ids[i]),
+        wallet-aligned. Raises on any VSS/commitment failure."""
+        mod, order = _curve(self.key_type)
+        q, t, B = len(self.ids), self.t, n_wallets
+        xs_tuple = tuple(self.xs[p] for p in self.ids)
+        coeffs = jnp.asarray(
+            _rand_scalars((q, t + 1, B), order, self.rng)
+        )
+        blinds = jnp.asarray(
+            np.frombuffer(
+                self.rng.token_bytes(q * B * 32), dtype=np.uint8
+            ).reshape(q, B, 32)
+        )
+        pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
+        # reveal phase is implicit in-process; re-check binding + VSS
+        subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
+        ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
+        if not bool(np.asarray(ok).all()):
+            raise RuntimeError("batched DKG: VSS verification failed")
+        # aggregate
+        ring = mod.scalar_ring()
+        agg_shares = []
+        for j in range(q):
+            sj = subshares[0, j]
+            for i in range(1, q):
+                sj = ring.addmod(sj, subshares[i, j])
+            agg_shares.append(np.asarray(sj))
+        agg_pts = []
+        for kdeg in range(t + 1):
+            acc = pts[0][kdeg]
+            for i in range(1, q):
+                acc = mod.add(acc, pts[i][kdeg])
+            agg_pts.append(acc)
+        agg_comp = [
+            _compress_host(self.key_type, acc) for acc in agg_pts
+        ]  # (t+1) lists of B byte strings
+        pubs = agg_comp[0]
+        shares_int = [
+            bn.batch_from_limbs(s, P256) for s in agg_shares
+        ]
+        out: List[List[KeygenShare]] = [[] for _ in self.ids]
+        for w in range(B):
+            vss = [agg_comp[kdeg][w] for kdeg in range(t + 1)]
+            for j, pid in enumerate(self.ids):
+                out[j].append(
+                    KeygenShare(
+                        key_type=self.key_type,
+                        share=shares_int[j][w],
+                        self_x=self.xs[pid],
+                        public_key=pubs[w],
+                        vss_commitments=vss,
+                        participants=list(self.ids),
+                        threshold=t,
+                    )
+                )
+        return out
+
+
+class BatchedReshare:
+    """In-process batched committee rotation (BASELINE config 5): an old
+    quorum re-deals B wallets' secrets to a new committee under a new
+    threshold; public keys unchanged (protocol/resharing.py semantics,
+    batched)."""
+
+    def __init__(
+        self,
+        old_quorum: Sequence[str],
+        old_shares: Sequence[Sequence[KeygenShare]],  # per old member
+        new_committee: Sequence[str],
+        new_threshold: int,
+        rng=secrets,
+    ):
+        self.old_quorum = list(old_quorum)
+        self.old_shares = old_shares
+        # preserve caller order: run()'s result[j] → new_committee[j]
+        self.new_committee = list(new_committee)
+        self.t_new = new_threshold
+        self.rng = rng
+        first = old_shares[0][0]
+        self.key_type = first.key_type
+        self.B = len(old_shares[0])
+        if not 0 < new_threshold < len(self.new_committee):
+            raise ValueError("need 0 < t_new < |new committee|")
+
+    def run(self) -> List[List[KeygenShare]]:
+        """Returns per-NEW-member share lists; verifies the redeal binds to
+        the old public keys."""
+        mod, order = _curve(self.key_type)
+        ring = mod.scalar_ring()
+        B, t_new = self.B, self.t_new
+        q_old = len(self.old_quorum)
+        new_xs = party_xs(self.new_committee)
+        xs_tuple = tuple(new_xs[p] for p in self.new_committee)
+        first = self.old_shares[0][0]
+        old_xs = party_xs(first.participants)
+        quorum_xs = [old_xs[p] for p in self.old_quorum]
+
+        # coeff0 = w_i = λ_i·x_i; higher coeffs fresh
+        coeffs_np = _rand_scalars((q_old, t_new + 1, B), order, self.rng)
+        for i, pid in enumerate(self.old_quorum):
+            lam = hm.lagrange_coeff(quorum_xs, old_xs[pid], order)
+            w = [
+                lam * s.share % order for s in self.old_shares[i]
+            ]
+            coeffs_np[i, 0] = bn.batch_to_limbs(w, P256)
+        coeffs = jnp.asarray(coeffs_np)
+        blinds = jnp.asarray(
+            np.frombuffer(
+                self.rng.token_bytes(q_old * B * 32), dtype=np.uint8
+            ).reshape(q_old, B, 32)
+        )
+        pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
+        subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
+        ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
+
+        # redeal binding: Σ_i C_i0 must equal the old public key
+        pub_sum = pts[0][0]
+        for i in range(1, q_old):
+            pub_sum = mod.add(pub_sum, pts[i][0])
+        pub_comp = _compress_host(self.key_type, pub_sum)
+        for w in range(B):
+            if pub_comp[w] != self.old_shares[0][w].public_key:
+                raise RuntimeError(
+                    f"resharing changed the public key for wallet {w}"
+                )
+        if not bool(np.asarray(ok).all()):
+            raise RuntimeError("batched resharing: VSS verification failed")
+
+        agg_shares = []
+        for j in range(len(self.new_committee)):
+            sj = subshares[0, j]
+            for i in range(1, q_old):
+                sj = ring.addmod(sj, subshares[i, j])
+            agg_shares.append(np.asarray(sj))
+        agg_comp = []
+        for kdeg in range(t_new + 1):
+            acc = pts[0][kdeg]
+            for i in range(1, q_old):
+                acc = mod.add(acc, pts[i][kdeg])
+            agg_comp.append(_compress_host(self.key_type, acc))
+        shares_int = [bn.batch_from_limbs(s, P256) for s in agg_shares]
+        epoch = first.epoch + 1
+        out: List[List[KeygenShare]] = [[] for _ in self.new_committee]
+        for w in range(B):
+            vss = [agg_comp[kdeg][w] for kdeg in range(t_new + 1)]
+            for j, pid in enumerate(self.new_committee):
+                out[j].append(
+                    KeygenShare(
+                        key_type=self.key_type,
+                        share=shares_int[j][w],
+                        self_x=new_xs[pid],
+                        public_key=self.old_shares[0][w].public_key,
+                        vss_commitments=vss,
+                        participants=list(self.new_committee),
+                        threshold=t_new,
+                        epoch=epoch,
+                        aux={"is_reshared": True},
+                    )
+                )
+        return out

@@ -1,0 +1,61 @@
+"""Batched DKG + resharing engines vs host-math ground truth."""
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.engine.dkg_batch import BatchedDKG, BatchedReshare
+
+
+def _recombine(shares, order, gen, mul, compress):
+    xs = [s.self_x for s in shares]
+    sec = 0
+    for s in shares:
+        lam = hm.lagrange_coeff(xs, s.self_x, order)
+        sec = (sec + lam * s.share) % order
+    return compress(mul(sec, gen))
+
+
+def test_eddsa_batched_dkg_recombines():
+    dkg = BatchedDKG(["n0", "n1", "n2"], threshold=1, key_type="ed25519")
+    shares = dkg.run(3)
+    for w in range(3):
+        got = _recombine(
+            [shares[0][w], shares[2][w]], hm.ED_L, hm.ED_B, hm.ed_mul,
+            hm.ed_compress,
+        )
+        assert got == shares[0][w].public_key
+        assert shares[1][w].epoch == 0
+        assert len(shares[0][w].vss_commitments) == 2
+
+
+def test_secp_batched_dkg_recombines():
+    dkg = BatchedDKG(["n0", "n1", "n2"], threshold=1, key_type="secp256k1")
+    shares = dkg.run(2)
+    for w in range(2):
+        got = _recombine(
+            [shares[0][w], shares[1][w]], hm.SECP_N, hm.SECP_G, hm.secp_mul,
+            hm.secp_compress,
+        )
+        assert got == shares[0][w].public_key
+
+
+def test_batched_reshare_2of3_to_3of5():
+    dkg = BatchedDKG(["n0", "n1", "n2"], threshold=1, key_type="ed25519")
+    shares = dkg.run(3)
+    rs = BatchedReshare(
+        ["n0", "n1"], [shares[0], shares[1]],
+        ["m0", "m1", "m2", "m3", "m4"], new_threshold=2,
+    )
+    new = rs.run()
+    for w in range(3):
+        trio = [new[0][w], new[2][w], new[4][w]]
+        got = _recombine(trio, hm.ED_L, hm.ED_B, hm.ed_mul, hm.ed_compress)
+        assert got == shares[0][w].public_key  # key unchanged
+        assert new[0][w].epoch == 1
+        assert new[0][w].aux.get("is_reshared")
+        assert new[0][w].threshold == 2
+    # old 2-subset of new committee alone must NOT recombine (t_new = 2)
+    pair = [new[0][0], new[1][0]]
+    got = _recombine(pair, hm.ED_L, hm.ED_B, hm.ed_mul, hm.ed_compress)
+    assert got != shares[0][0].public_key
