@@ -72,7 +72,11 @@ def _ensure_backend() -> str:
 
 def main() -> None:
     platform = _ensure_backend()
-    B = int(os.environ.get("MPCIUM_BENCH_B", "1024"))
+    default_b = "1024" if platform == "tpu" else "8"
+    # CPU fallback shrinks the batch: full-size GG18 at B=1024 is hours of
+    # single-core arithmetic — a small-batch number with platform: "cpu"
+    # is the honest degraded result (explicit MPCIUM_BENCH_B overrides)
+    B = int(os.environ.get("MPCIUM_BENCH_B", default_b))
     runs = int(os.environ.get("MPCIUM_BENCH_RUNS", "1"))
 
     import jax

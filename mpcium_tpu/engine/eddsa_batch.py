@@ -152,10 +152,23 @@ def fused_sign_step(
 def challenge_hashes(
     R_comp: np.ndarray, A_comp: np.ndarray, messages: Sequence[bytes]
 ) -> np.ndarray:
-    """Per-session SHA-512(R ‖ A ‖ M) → (B, 64) uint8."""
-    out = np.empty((len(messages), 64), dtype=np.uint8)
+    """Per-session SHA-512(R ‖ A ‖ M) → (B, 64) uint8.
+
+    Equal-length messages (the common case: 32-byte tx digests) hash as ONE
+    native batch call (native.batch_sha512 — C++, one call per batch
+    instead of B Python hashlib calls); ragged batches fall back per row.
+    """
+    from .. import native
+
     R = np.asarray(R_comp)
     A = np.asarray(A_comp)
+    lens = {len(m) for m in messages}
+    if len(lens) == 1:
+        M = np.frombuffer(b"".join(messages), dtype=np.uint8).reshape(
+            len(messages), lens.pop()
+        )
+        return native.batch_sha512(b"", np.concatenate([R, A, M], axis=1))
+    out = np.empty((len(messages), 64), dtype=np.uint8)
     for i, m in enumerate(messages):
         out[i] = np.frombuffer(
             hashlib.sha512(R[i].tobytes() + A[i].tobytes() + m).digest(),
@@ -250,23 +263,33 @@ class BatchedCoSigners:
         assert len(messages) == self.B
         q, B = self.q, self.B
 
-        # -- round 1: nonce commitments (one (q, B) dispatch) + host commits -
+        # -- round 1: nonce commitments (one (q, B) dispatch) + batch
+        # commitments (native C++ SHA-256: one call per party, not B) ------
+        from .. import native
+
         r64 = np.stack([fresh_nonce_bytes(B, self.rng) for _ in range(q)])
         r_limbs, R_comp = nonce_commitments(jnp.asarray(r64))  # (q,B,22)/(q,B,32)
         R_host = np.asarray(R_comp)
-        from ..protocol import commitments as cm
-
-        commits: List[List[Tuple[bytes, bytes]]] = [
-            [cm.commit(R_host[p][i].tobytes(), self.rng) for i in range(B)]
+        blinds = [
+            np.frombuffer(self.rng.token_bytes(B * 32), dtype=np.uint8)
+            .reshape(B, 32) for _ in range(q)
+        ]
+        commits = [
+            native.batch_sha256(
+                b"mpcium-tpu/eddsa-commit",
+                np.concatenate([blinds[p], R_host[p]], axis=1),
+            )
             for p in range(q)
         ]
 
-        # -- round 2: decommit + verify (host hash check, device aggregate) -
+        # -- round 2: decommit + verify (batch hash check, device aggregate)
         for p in range(q):
-            for i in range(B):
-                c, blind = commits[p][i]
-                if not cm.verify(c, blind, R_host[p][i].tobytes()):
-                    raise RuntimeError("commitment fraud detected")
+            again = native.batch_sha256(
+                b"mpcium-tpu/eddsa-commit",
+                np.concatenate([blinds[p], R_host[p]], axis=1),
+            )
+            if not (again == commits[p]).all():
+                raise RuntimeError("commitment fraud detected")
         R_sum, ok_R = aggregate_nonce(jnp.asarray(R_host))
 
         # -- round 3: challenge (host hash) + partials (one (q, B) dispatch)
