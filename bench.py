@@ -130,7 +130,7 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "secp256k1_2of3_gg18_sigs_per_sec",
-                "value": round(sigs_per_sec, 1),
+                "value": round(sigs_per_sec, 3),
                 "unit": "signatures/sec",
                 "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 4),
                 "platform": platform,
