@@ -20,7 +20,6 @@ Layer map (mirrors SURVEY.md §7.2 build order):
               keygen / signing / resharing
   engine/     the batch scheduler: pad/bucket sessions into fixed-shape
               dispatches, vmap/shard_map over the session axis
-  parallel/   mesh + sharding helpers (ICI-friendly layouts)
   transport/  pub/sub, acked unicast, durable idempotent queues, dead-letter
   registry/   peer liveness registry
   store/      encrypted share store + wallet keyinfo metadata

@@ -1,0 +1,8 @@
+"""Batched execution engines — the TPU layer with no reference analogue.
+
+- ``eddsa_batch``  threshold-Ed25519 co-signing over a session batch
+- ``gg18_batch``   threshold-ECDSA (GG18) co-signing on the MXU kernels
+- ``dkg_batch``    batched Feldman DKG + committee resharing
+- ``sharded``      multi-device meshes: (committee × sessions) shard_map
+                   for EdDSA, session-axis GSPMD sharding for GG18
+"""

@@ -4,7 +4,7 @@ The generic engine in :mod:`core.bignum` expresses everything as int32
 einsums and sequential carry scans — correct, but it leaves the MXU idle
 and serializes on limb-length scans. This module re-formulates the same
 operations around three measured-on-chip facts (TPU v5e, B=4096, 4096-bit
-operands — see .scratch/prof5/prof6 and the numbers in OPS_NOTES below):
+operands; measurements from the on-chip microbenches):
 
 1. **Multiplication by a per-modulus constant is a Toeplitz matmul.**
    Barrett reduction multiplies by two constants (mu and m). With 7-bit
