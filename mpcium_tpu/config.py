@@ -30,6 +30,8 @@ class AppConfig:
     broker_port: int = 4333
     broker_token: str = ""  # shared auth token (reference NATS credentials)
     broker_journal: str = ""  # queue journal path ("" = in-memory queues)
+    batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
+    batch_window_s: float = 0.05
     peers_file: str = "peers.json"
 
     def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:
@@ -55,13 +57,19 @@ def init_config(path: Optional[str] = None, **overrides) -> AppConfig:
     cfg_path = Path(path) if path else Path("config.yaml")
     if cfg_path.exists():
         data.update(yaml.safe_load(cfg_path.read_text()) or {})
+    def _coerce(current, raw):
+        # bool("false") is True — parse the usual spellings explicitly
+        if isinstance(current, bool) and isinstance(raw, str):
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return type(current)(raw)
+
     cfg = AppConfig()
     for f in fields(AppConfig):
         if f.name in data:
-            setattr(cfg, f.name, type(getattr(cfg, f.name))(data[f.name]))
+            setattr(cfg, f.name, _coerce(getattr(cfg, f.name), data[f.name]))
         env = os.environ.get("MPCIUM_" + f.name.upper().replace(".", "_"))
         if env is not None:
-            setattr(cfg, f.name, type(getattr(cfg, f.name))(env))
+            setattr(cfg, f.name, _coerce(getattr(cfg, f.name), env))
     for k, v in overrides.items():
         if v is not None:
             setattr(cfg, k, v)

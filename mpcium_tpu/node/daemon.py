@@ -92,7 +92,11 @@ def run_node(
         registry=registry,
         safe_prime_pool=cfg.safe_prime_pool or None,
     )
-    consumer = EventConsumer(node, transport)
+    consumer = EventConsumer(
+        node, transport,
+        batch_signing=cfg.batch_signing,
+        batch_window_s=cfg.batch_window_s,
+    )
     consumer.run()
     TimeoutConsumer(transport).run()
     registry.ready()
