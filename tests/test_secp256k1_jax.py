@@ -4,6 +4,7 @@ import secrets
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mpcium_tpu.core import hostmath as hm
 from mpcium_tpu.core import secp256k1_jax as sj
@@ -26,6 +27,7 @@ def test_add_matches_host():
         assert got == hm.secp_mul((a + b) % hm.SECP_N, hm.SECP_G)
 
 
+@pytest.mark.slow
 def test_complete_edge_cases():
     """The completeness claims: P+(-P)=O, P+O=P, O+O=O, P+P=2P."""
     k = rand_scalars(1)[0]
