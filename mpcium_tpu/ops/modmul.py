@@ -168,9 +168,58 @@ def mul_const(x: jnp.ndarray, T: jnp.ndarray) -> jnp.ndarray:
     return out.astype(jnp.int32)
 
 
+import os
+
+# Pairwise-product strategy: "i32" (blocked int32 einsum — the measured
+# baseline) or "bf16" (same block structure with bf16 multiplicands and f32
+# accumulation — exact for 7-bit limbs, and a candidate to hit the MXU's
+# native bf16 path; flip via MPCIUM_MULPAIR once measured on the chip).
+MULPAIR_STRATEGY = os.environ.get("MPCIUM_MULPAIR", "i32")
+
+
+def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Blocked-einsum pairwise product with bf16 inputs / f32 accumulation.
+
+    Exactness: normalized 7-bit limbs (≤127) are exact bf16 values; a
+    32-limb block-product column is ≤ 32·127² < 2²⁴ (f32-exact), and the
+    overlap-add sums ≤ 19 such columns < 2²⁴. Requires NORMALIZED inputs
+    (the i32 path tolerates mildly redundant limbs; this one does not).
+    """
+    n_x, n_y = x.shape[-1], y.shape[-1]
+    bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
+    xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
+        x.shape[:-1] + (bx, _BLOCK)
+    ).astype(jnp.bfloat16)
+    yb = bn.take_limbs(y, 0, by * _BLOCK).reshape(
+        y.shape[:-1] + (by, _BLOCK)
+    ).astype(jnp.bfloat16)
+    m = jnp.asarray(np.asarray(bn._conv_tensor(_BLOCK, _BLOCK)), jnp.bfloat16)
+    prods = jnp.einsum(
+        "...ui,...vj,ijn->...uvn", xb, yb, m,
+        preferred_element_type=jnp.float32,
+    )
+    bt = bx + by - 1
+    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.float32)
+    lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
+    hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
+    hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
+    lo_flat = jnp.pad(
+        lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (lo.ndim - 2) + [(0, _BLOCK)],
+    ).astype(jnp.int32)
+    hi_flat = jnp.pad(
+        hi.reshape(hi.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (hi.ndim - 2) + [(_BLOCK, 0)],
+    ).astype(jnp.int32)
+    total = carry(lo_flat + hi_flat)
+    return total[..., : n_x + n_y]
+
+
 def mul_pair(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """Pairwise (batched × batched) product → normalized (n_x+n_y) limbs.
-    Blocked einsum in the 7-bit family (prof5 candidate G)."""
+    Blocked einsum in the 7-bit family; strategy via MPCIUM_MULPAIR."""
+    if MULPAIR_STRATEGY == "bf16":
+        return _mul_pair_bf16(x, y)
     prof = bn.LimbProfile(bits=LIMB_BITS, n_limbs=max(x.shape[-1], y.shape[-1]))
     return bn.mul_wide(x, y, prof)
 
