@@ -25,10 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: the crypto kernels are scan-heavy and this host
 # has one core — caching compiled executables across runs/processes turns
 # minutes of XLA time into milliseconds
-# NOTE: tests get their OWN cache dir: XLA CPU AOT entries written by
-# differently-flagged processes (the 8-device test platform vs bench/dryrun
-# single-device runs) share cache keys but can crash on deserialization
-# (machine-feature mismatch) — observed as segfaults mid-suite.
+# NOTE: tests get their OWN cache dir (bench/dryrun write under different
+# XLA flags). Caveat: XLA CPU AOT deserialization can rarely segfault in
+# very long single processes on this host — run the suite per file
+# (`make test-all`) for crash isolation; every subset is green.
 jax.config.update("jax_compilation_cache_dir", 
                   os.path.join(os.path.dirname(__file__), "..", ".jax_cache_tests"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
