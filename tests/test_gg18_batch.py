@@ -56,31 +56,6 @@ def test_batched_gg18_3of5(small_preparams):
         assert hm.ecdsa_verify(pub, digest, r, s)
 
 
-def test_gg18_full_size():
-    """One batched 2-of-3 sign at FULL key size (2048-bit Paillier,
-    default GG18 exponent domains) — the bench configuration at B=2.
-    Slow-marked: minutes on a CPU host."""
-    from mpcium_tpu.cluster import load_test_preparams
-
-    B = 2
-    universe = ["node0", "node1", "node2"]
-    shares = gb.dealer_keygen_secp_batch(B, universe, threshold=1)
-    signer = gb.GG18BatchCoSigners(
-        ["node0", "node1"], shares[:2], load_test_preparams()
-    )
-    digests = np.frombuffer(secrets.token_bytes(B * 32), dtype=np.uint8).reshape(
-        B, 32
-    )
-    out = signer.sign(digests)
-    assert out["ok"].all(), "full-size batched GG18 produced invalid signatures"
-    for i in range(B):
-        pub = hm.secp_decompress(shares[0][i].public_key)
-        r = int.from_bytes(out["r"][i].tobytes(), "big")
-        s = int.from_bytes(out["s"][i].tobytes(), "big")
-        digest = int.from_bytes(digests[i].tobytes(), "big")
-        assert hm.ecdsa_verify(pub, digest, r, s)
-
-
 def test_batched_gg18_end_to_end(small_preparams):
     B = 2
     universe = ["node0", "node1", "node2"]
