@@ -39,7 +39,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     broker.add_argument(
         "--token", default=os.environ.get("MPCIUM_BROKER_TOKEN", ""),
-        help="shared auth token (or MPCIUM_BROKER_TOKEN)",
+        help="shared auth token, plaintext or sha256:<hex> "
+        "(or MPCIUM_BROKER_TOKEN)",
+    )
+    broker.add_argument(
+        "--encrypt", action="store_true",
+        default=os.environ.get("MPCIUM_BROKER_ENCRYPT", "").lower()
+        not in ("", "0", "false", "no"),
+        help="AEAD-encrypt every connection (X25519 + token-bound "
+        "ChaCha20-Poly1305; or MPCIUM_BROKER_ENCRYPT=1)",
     )
     sub.add_parser("version", help="print version")
     return p
@@ -63,7 +71,8 @@ def main(argv=None) -> int:
         from mpcium_tpu.node.daemon import run_broker
 
         return run_broker(host=args.host, port=args.port,
-                          journal=args.journal, token=args.token)
+                          journal=args.journal, token=args.token,
+                          encrypt=args.encrypt)
     build_parser().print_help()
     return 1
 

@@ -29,6 +29,7 @@ class AppConfig:
     broker_host: str = "127.0.0.1"  # TCP bus (the NATS analogue)
     broker_port: int = 4333
     broker_token: str = ""  # shared auth token (reference NATS credentials)
+    broker_encrypt: bool = False  # AEAD channel (reference prod TLS posture)
     broker_journal: str = ""  # queue journal path ("" = in-memory queues)
     batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
     batch_window_s: float = 0.05

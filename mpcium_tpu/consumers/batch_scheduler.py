@@ -14,15 +14,19 @@ participant (static: no election, no races). The leader buffers requests
 for ``window_s`` (or until ``max_batch``), then broadcasts a manifest
 listing the batch, **signed with its node identity**; receivers verify
 both the leader signature and — because the leader is otherwise untrusted
-for content — every entry's ORIGINAL initiator signature. Followers buffer
-their requests purely as a liveness fallback: if no manifest covers a
-request within ``manifest_timeout_s`` (leader down), it falls back to the
-per-session signing path (one bucket-level timer, not one per request).
+for content — every entry's ORIGINAL initiator signature. Requests stay
+buffered on EVERY member (leader included) until a manifest covers them:
+manifest arrival removes them and hands their dedup claims to the batch;
+if no manifest covers a request within ``manifest_timeout_s`` (leader
+down, manifest lost), it falls back to the per-session signing path (one
+bucket-level timer, not one per request).
 
-secp256k1 note: GG18's batched engine (engine.gg18_batch) currently runs
-as an in-process fabric (bench/measurement); its distributed per-party
-round exchange is future work, so ECDSA requests take the per-session
-path. The scheduler's bucketing/manifest machinery is curve-agnostic.
+Both curves batch: ed25519 via protocol.eddsa.batch_signing (3 rounds)
+and secp256k1 via protocol.ecdsa.batch_signing (distributed GG18, 9
+rounds on the engine kernels). ECDSA buckets additionally key on the
+quorum's Paillier/ring-Pedersen material digest so one batch maps to one
+modulus-context set; wallets with no GG18 aux material (never produced by
+this framework's keygen) fall back to the per-session path.
 """
 from __future__ import annotations
 
@@ -44,18 +48,37 @@ from ..utils import log
 
 @dataclass
 class _Entry:
-    msg: wire.SignTxMessage
+    msg: object  # SignTxMessage ("sign") or GenerateKeyMessage ("kg")
     reply_topic: str
     added_at: float = field(default_factory=time.monotonic)
+    fired: bool = False  # leader: already covered by a published manifest
+    kind: str = "sign"
 
 
 def _bucket_key(info) -> Tuple:
     return (tuple(info.participant_peer_ids), info.threshold, info.epoch)
 
 
-def _manifest_body(batch_id: str, leader: str, requests: List[dict]) -> bytes:
+def _entry_key(kind: str, msg) -> Tuple[str, str]:
+    """The (wallet, tx) identity used for claims and manifest coverage;
+    keygen/reshare requests have no tx axis."""
+    if kind == "kg":
+        return (msg.wallet_id, "")
+    if kind == "rs":
+        return (f"{msg.key_type}:{msg.wallet_id}", "")
+    return (msg.wallet_id, msg.tx_id)
+
+
+def _manifest_body(
+    batch_id: str, leader: str, requests: List[dict], kind: str
+) -> bytes:
     return wire.canonical_json(
-        {"batch_id": batch_id, "leader": leader, "requests": requests}
+        {
+            "batch_id": batch_id,
+            "leader": leader,
+            "requests": requests,
+            "kind": kind,
+        }
     )
 
 
@@ -73,6 +96,14 @@ class BatchSigningScheduler:
         on_tx_done: Optional[Callable[[str, str], None]] = None,
         on_tx_released: Optional[Callable[[str, str], None]] = None,
         claim_tx: Optional[Callable[[str, str], bool]] = None,
+        on_fallback_keygen: Optional[Callable] = None,
+        on_kg_done: Optional[Callable[[str], None]] = None,
+        on_kg_released: Optional[Callable[[str], None]] = None,
+        claim_kg: Optional[Callable[[str], bool]] = None,
+        on_fallback_reshare: Optional[Callable] = None,
+        on_rs_done: Optional[Callable[[str, str], None]] = None,
+        on_rs_released: Optional[Callable[[str, str], None]] = None,
+        claim_rs: Optional[Callable[[str, str], bool]] = None,
     ):
         self.node = node
         self.transport = transport
@@ -84,12 +115,32 @@ class BatchSigningScheduler:
         self.on_tx_done = on_tx_done or (lambda w, t: None)
         self.on_tx_released = on_tx_released or (lambda w, t: None)
         self.claim_tx = claim_tx or (lambda w, t: True)
+        self.on_fallback_keygen = on_fallback_keygen
+        self.on_kg_done = on_kg_done or (lambda w: None)
+        self.on_kg_released = on_kg_released or (lambda w: None)
+        self.claim_kg = claim_kg or (lambda w: True)
+        self.on_fallback_reshare = on_fallback_reshare
+        self.on_rs_done = on_rs_done or (lambda kt, w: None)
+        self.on_rs_released = on_rs_released or (lambda kt, w: None)
+        self.claim_rs = claim_rs or (lambda kt, w: True)
         self._lock = threading.RLock()
         self._buckets: Dict[Tuple, List[_Entry]] = {}
         self._timers: Dict[Tuple, threading.Timer] = {}  # leader windows +
         # follower fallbacks, keyed ("win"|"fb", bucket)
         self._sessions: List[Session] = []
         self.batches_run = 0  # engine-dispatch diagnostic (tests assert ≪ N)
+        # GG18 exponent domains (None = production defaults); tests with
+        # shrunk keys set this on every quorum member's scheduler
+        self.gg18_dom = None
+        # hello/unicast budgets for batch sessions: one round of a batched
+        # party can spend minutes in XLA compiles or DLN verification, so
+        # a busy (not gone) peer must not trip the 3x3s transport budget
+        # or the 20s hello deadline
+        self.batch_patience_s = 900.0
+        self._decline_responders: Dict[str, Tuple] = {}
+        # secp material digests are constant per (wallet, epoch) — cache so
+        # a request burst doesn't re-load/re-hash the share per tx
+        self._digest_cache: Dict[Tuple[str, str, int], str] = {}
         self._sub = transport.pubsub.subscribe(
             wire.TOPIC_BATCH_MANIFEST, self._on_manifest_raw
         )
@@ -104,6 +155,13 @@ class BatchSigningScheduler:
             self._timers.clear()
             for s in self._sessions:
                 s.close()
+            for sub, t in self._decline_responders.values():
+                t.cancel()
+                try:
+                    sub.unsubscribe()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._decline_responders.clear()
 
     # -- request intake ------------------------------------------------------
 
@@ -111,29 +169,90 @@ class BatchSigningScheduler:
         """Buffer a verified signing request for batching. Returns False if
         the request cannot be batched (caller should use the per-session
         path). The caller holds the dedup claim for this tx."""
-        if msg.key_type != wire.KEY_TYPE_ED25519:
+        if msg.key_type not in (
+            wire.KEY_TYPE_ED25519, wire.KEY_TYPE_SECP256K1
+        ):
             return False
         info = self.node.keyinfo.get(msg.key_type, msg.wallet_id)
         if info is None:
             return False
-        key = _bucket_key(info)
+        extra: Tuple = ()
+        if msg.key_type == wire.KEY_TYPE_SECP256K1:
+            # one batch = one modulus-context set: bucket on the quorum's
+            # Paillier/ring-Pedersen material (batch_signing module doc).
+            # The digest is constant per (wallet, epoch) — cached, so a
+            # burst of txs costs one share load, not one per tx.
+            ck = (msg.key_type, msg.wallet_id, info.epoch)
+            dig = self._digest_cache.get(ck)
+            if dig is None:
+                from ..protocol.ecdsa.batch_signing import (
+                    quorum_material_digest,
+                )
+
+                try:
+                    share = self.node.load_share(msg.key_type, msg.wallet_id)
+                except ProtocolError:
+                    return False
+                if share.epoch != info.epoch:
+                    return False  # mid-reshare — per-session path retries
+                dig = quorum_material_digest(share)
+                self._digest_cache[ck] = dig
+            if not dig:
+                return False  # no GG18 aux → per-session path
+            extra = (dig,)
+        key = _bucket_key(info) + (msg.key_type,) + extra
         leader = sorted(info.participant_peer_ids)[0]
-        entry = _Entry(msg, reply_topic)
+        return self._buffer_entry(key, _Entry(msg, reply_topic), leader)
+
+    def submit_keygen(self, msg: wire.GenerateKeyMessage) -> bool:
+        """Buffer a verified wallet-creation request for batched DKG
+        (engine kernels via protocol.batch_dkg, both curves). Returns False
+        when batching does not apply; the caller holds the keygen dedup
+        claim."""
+        # keygen runs over the FULL configured cluster (reference
+        # node.go:95); every node sees every request via pub/sub
+        if self.node.registry.ready_count() < len(self.node.peer_ids):
+            return False
+        key = ("kg", tuple(self.node.peer_ids), self._threshold())
+        leader = sorted(self.node.peer_ids)[0]
+        return self._buffer_entry(key, _Entry(msg, "", kind="kg"), leader)
+
+    def submit_reshare(self, msg: wire.ResharingMessage) -> bool:
+        """Buffer a verified resharing request for batched rotation
+        (protocol.batch_dkg.BatchedReshareParty). Wallets bucket by curve +
+        old topology + new threshold so one re-deal serves the batch."""
+        info = self.node.keyinfo.get(msg.key_type, msg.wallet_id)
+        if info is None:
+            return False
+        key = (
+            "rs", msg.key_type, tuple(info.participant_peer_ids),
+            info.threshold, info.epoch, msg.new_threshold,
+        )
+        leader = sorted(info.participant_peer_ids)[0]
+        return self._buffer_entry(key, _Entry(msg, "", kind="rs"), leader)
+
+    def _buffer_entry(self, key: Tuple, entry: _Entry, leader: str) -> bool:
+        """Shared intake: append to the bucket, fire/arm the leader window,
+        arm the bucket-level liveness fallback."""
         with self._lock:
             if self._closed:
                 return False
             self._buckets.setdefault(key, []).append(entry)
             if self.node.node_id == leader:
-                if len(self._buckets[key]) >= self.max_batch:
+                unfired = sum(1 for e in self._buckets[key] if not e.fired)
+                if unfired >= self.max_batch:
                     self._fire(key)
                 elif ("win", key) not in self._timers:
                     t = threading.Timer(self.window_s, self._fire, (key,))
                     t.daemon = True
                     t.start()
                     self._timers[("win", key)] = t
-            elif ("fb", key) not in self._timers:
-                # follower: ONE bucket-level liveness timer (re-armed while
-                # entries remain), not one thread per request
+            if ("fb", key) not in self._timers:
+                # ONE bucket-level liveness timer (re-armed while entries
+                # remain), not one thread per request. The leader arms it
+                # too: entries stay bucketed until its own manifest loops
+                # back through pub/sub, so a lost manifest degrades to the
+                # per-session path instead of stranding the dedup claims.
                 t = threading.Timer(
                     self.manifest_timeout_s, self._fallback_sweep, (key,)
                 )
@@ -142,25 +261,93 @@ class BatchSigningScheduler:
                 self._timers[("fb", key)] = t
         return True
 
+    def _threshold(self) -> int:
+        from ..config import get_config
+
+        return get_config().mpc_threshold
+
+    def _decline_batch(self, session_id: str, topic: str, reason: str) -> None:
+        """Announce that this node will NOT join a batch session, and keep
+        answering peers' hellos with the decline for one patience window
+        (a peer may still be minutes inside party-construction compiles
+        when the first decline goes out). Peers fail retryably instead of
+        waiting out their generous hello deadline."""
+        from ..node.session import HELLO_ROUND, Session
+        from ..wire import Envelope
+
+        def bye():
+            try:
+                Session.send_decline(
+                    self.transport, self.node.identity, self.node.node_id,
+                    session_id, topic, reason,
+                )
+            except Exception:  # noqa: BLE001
+                pass  # transport shutting down
+
+        bye()
+        if self._closed:
+            return
+
+        def on_raw(raw: bytes) -> None:
+            try:
+                env = Envelope.decode(raw)
+            except Exception:  # noqa: BLE001
+                return
+            if (
+                env.session_id == session_id
+                and env.from_id != self.node.node_id
+                and env.round == HELLO_ROUND
+                and not env.payload.get("bye")
+            ):
+                bye()
+
+        sub = self.transport.pubsub.subscribe(topic, on_raw)
+
+        def expire():
+            sub.unsubscribe()
+            with self._lock:
+                self._decline_responders.pop(session_id, None)
+
+        t = threading.Timer(self.batch_patience_s, expire)
+        t.daemon = True
+        t.start()
+        with self._lock:
+            if self._closed:
+                t.cancel()
+                sub.unsubscribe()
+                return
+            self._decline_responders[session_id] = (sub, t)
+
     # -- leader: manifest emission ------------------------------------------
 
     def _fire(self, key: Tuple) -> None:
+        """Publish a manifest covering the bucket's unfired entries. The
+        entries STAY in the bucket (marked fired) until the manifest loops
+        back through _on_manifest_raw, which removes them and hands their
+        dedup claims to the batch — the same path followers take, so the
+        leader's claims can never be stranded by the old pop-and-forget."""
         with self._lock:
             t = self._timers.pop(("win", key), None)
             if t:
                 t.cancel()
-            entries = self._buckets.pop(key, [])
+            entries = [
+                e for e in self._buckets.get(key, []) if not e.fired
+            ][: self.max_batch]
+            for e in entries:
+                e.fired = True
         if not entries:
             return
+        kind = entries[0].kind
         batch_id = secrets.token_hex(8)
         requests = [
             {"msg": e.msg.to_json(), "reply": e.reply_topic} for e in entries
         ]
-        body = _manifest_body(batch_id, self.node.node_id, requests)
+        body = _manifest_body(batch_id, self.node.node_id, requests, kind)
         manifest = {
             "batch_id": batch_id,
             "leader": self.node.node_id,
             "requests": requests,
+            "kind": kind,
             "sig": self.node.identity.sign_raw(body).hex(),
         }
         self.transport.pubsub.publish(
@@ -191,9 +378,15 @@ class BatchSigningScheduler:
                 self._timers[("fb", key)] = t
         for e in stale:
             log.warn("batch manifest timeout — per-session fallback",
-                     wallet=e.msg.wallet_id, tx=e.msg.tx_id,
+                     wallet=e.msg.wallet_id, kind=e.kind,
                      node=self.node.node_id)
-            if self.on_fallback:
+            if e.kind == "kg":
+                if self.on_fallback_keygen:
+                    self.on_fallback_keygen(e.msg)
+            elif e.kind == "rs":
+                if self.on_fallback_reshare:
+                    self.on_fallback_reshare(e.msg)
+            elif self.on_fallback:
                 self.on_fallback(e.msg, e.reply_topic)
 
     # -- all quorum members: manifest execution ------------------------------
@@ -205,8 +398,13 @@ class BatchSigningScheduler:
             leader = man["leader"]
             sig = bytes.fromhex(man["sig"])
             requests = man["requests"]
+            kind = man.get("kind", "sign")
+            msg_cls = {
+                "kg": wire.GenerateKeyMessage,
+                "rs": wire.ResharingMessage,
+            }.get(kind, wire.SignTxMessage)
             reqs = [
-                (wire.SignTxMessage.from_json(r["msg"]), r.get("reply", ""))
+                (msg_cls.from_json(r["msg"]), r.get("reply", ""))
                 for r in requests
             ]
         except Exception as e:  # noqa: BLE001
@@ -217,25 +415,36 @@ class BatchSigningScheduler:
         # leader authenticity: must be signed by the node it claims to be
         # from, and that node must be the deterministic leader for the
         # wallets' topology (checked against OUR keyinfo below)
-        body = _manifest_body(batch_id, leader, requests)
+        body = _manifest_body(batch_id, leader, requests, kind)
         if not self.node.identity.verify_peer(leader, body, sig):
             log.warn("batch manifest with BAD leader signature dropped",
                      batch=batch_id)
+            return
+        if kind == "kg":
+            self._on_keygen_manifest(batch_id, leader, reqs)
+            return
+        if kind == "rs":
+            self._on_reshare_manifest(batch_id, leader, reqs)
             return
         info = self.node.keyinfo.get(reqs[0][0].key_type, reqs[0][0].wallet_id)
         if info is None or sorted(info.participant_peer_ids)[0] != leader:
             log.warn("batch manifest from non-leader dropped",
                      batch=batch_id, claimed=leader)
             return
-        # batch homogeneity: the leader is untrusted — every request must be
-        # ed25519 and share the (participants, threshold, epoch) bucket of
-        # the first (otherwise a leader for ONE wallet could smuggle foreign
-        # topologies/curves into followers' batches)
+        # batch homogeneity: the leader is untrusted — every request must
+        # share the first's curve and (participants, threshold, epoch)
+        # bucket (otherwise a leader for ONE wallet could smuggle foreign
+        # topologies/curves into followers' batches). ECDSA's Paillier-
+        # material homogeneity is enforced by the party constructor in
+        # _run_batch (requires share loads; a mixed batch fails retryably).
+        kt = reqs[0][0].key_type
+        if kt not in (wire.KEY_TYPE_ED25519, wire.KEY_TYPE_SECP256K1):
+            log.warn("unsupported curve in manifest dropped", batch=batch_id)
+            return
         want = _bucket_key(info)
         for msg, _reply in reqs:
-            if msg.key_type != wire.KEY_TYPE_ED25519:
-                log.warn("non-ed25519 request in manifest dropped",
-                         batch=batch_id)
+            if msg.key_type != kt:
+                log.warn("mixed-curve batch manifest dropped", batch=batch_id)
                 return
             winfo = self.node.keyinfo.get(msg.key_type, msg.wallet_id)
             if winfo is None or _bucket_key(winfo) != want:
@@ -250,58 +459,466 @@ class BatchSigningScheduler:
                          batch=batch_id)
                 return
         # drop covered entries from local buffers BEFORE any early return,
-        # so follower fallback timers cannot race a manifest we act on
-        covered = {(m.wallet_id, m.tx_id) for m, _ in reqs}
-        with self._lock:
-            for bucket in self._buckets.values():
-                bucket[:] = [
-                    e for e in bucket
-                    if (e.msg.wallet_id, e.msg.tx_id) not in covered
-                ]
+        # so follower fallback timers cannot race a manifest we act on.
+        # Entries pulled from our buckets carry a dedup claim acquired by
+        # the consumer's _on_sign before submit() — the batch inherits those
+        # claims and must finish/release them (a claim whose entry was never
+        # in a bucket belongs to a live per-session run, not to us).
+        covered = {_entry_key("sign", m) for m, _ in reqs}
+        inherited = self._inherit_covered("sign", covered)
         threading.Thread(
-            target=self._run_batch, args=(batch_id, reqs),
+            target=self._run_batch, args=(batch_id, reqs, inherited),
             name=f"bsign-{batch_id}", daemon=True,
         ).start()
 
+    def _inherit_covered(self, kind: str, covered) -> List[Tuple[str, str]]:
+        """Remove manifest-covered entries of ``kind`` from local buckets,
+        returning their claim keys (inherited by the batch)."""
+        inherited: List[Tuple[str, str]] = []
+        with self._lock:
+            for bucket in self._buckets.values():
+                kept = []
+                for e in bucket:
+                    k = _entry_key(e.kind, e.msg)
+                    if e.kind == kind and k in covered:
+                        inherited.append(k)
+                    else:
+                        kept.append(e)
+                bucket[:] = kept
+        return inherited
+
+    # -- batched DKG (kind == "kg") ------------------------------------------
+
+    def _on_keygen_manifest(self, batch_id: str, leader: str, reqs) -> None:
+        node = self.node
+        if leader != sorted(node.peer_ids)[0]:
+            log.warn("keygen manifest from non-leader dropped",
+                     batch=batch_id, claimed=leader)
+            return
+        for msg, _r in reqs:
+            if not node.identity.verify_initiator(msg.raw(), msg.signature):
+                log.warn("keygen manifest with BAD initiator signature "
+                         "dropped", batch=batch_id)
+                return
+        covered = {_entry_key("kg", m) for m, _ in reqs}
+        inherited = self._inherit_covered("kg", covered)
+        threading.Thread(
+            target=self._run_keygen_batch, args=(batch_id, reqs, inherited),
+            name=f"bdkg-{batch_id}", daemon=True,
+        ).start()
+
+    def _run_keygen_batch(
+        self, batch_id: str, reqs, inherited: List[Tuple[str, str]] = ()
+    ) -> None:
+        from ..protocol.batch_dkg import BatchedDKGParty
+
+        node = self.node
+        owned = set(inherited)
+        for msg, _r in reqs:
+            k = _entry_key("kg", msg)
+            if k not in owned and self.claim_kg(msg.wallet_id):
+                owned.add(k)
+        def decline_both(reason: str):
+            for kt in (wire.KEY_TYPE_SECP256K1, wire.KEY_TYPE_ED25519):
+                self._decline_batch(
+                    f"bdkg:{kt}:{batch_id}",
+                    f"bdkg:broadcast:{kt}:{batch_id}", reason,
+                )
+
+        if len(owned) < len(reqs):
+            # some lane's claim is held by a live per-session fallback run
+            # (the manifest arrived late). Unlike signing — where running
+            # both paths is harmless (results are idempotent, nothing is
+            # persisted) — a keygen batch PERSISTS key material, and two
+            # concurrent DKGs for one wallet could write shares of
+            # different keys on different nodes. Refuse the whole batch:
+            # peers that did join fail cleanly without persisting; the
+            # initiator retries.
+            log.warn("keygen batch refused — lane owned by live fallback",
+                     batch=batch_id, node=node.node_id)
+            for w, _t in owned:
+                self.on_kg_released(w)
+            decline_both("lane owned by live fallback")
+            return
+
+        def emit_error(wallet_id: str, reason: str):
+            ev = wire.KeygenSuccessEvent(
+                wallet_id=wallet_id, ecdsa_pub_key="", eddsa_pub_key="",
+                result_type=wire.RESULT_ERROR, error_reason=reason,
+            )
+            self.transport.queues.enqueue(
+                f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}",
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=f"{wallet_id}-err",
+            )
+
+        def fail_all(reason: str):
+            # mpc:generate is an ephemeral command (no durable redelivery,
+            # reference semantics) — surface terminal errors
+            for msg, _r in reqs:
+                if _entry_key("kg", msg) in owned:
+                    emit_error(msg.wallet_id, reason)
+                    self.on_kg_done(msg.wallet_id)
+
+        if node.registry.ready_count() < len(node.peer_ids):
+            fail_all("cluster not ready for keygen")
+            decline_both("cluster not ready for keygen")
+            return
+        threshold = self._threshold()
+        B = len(reqs)
+        participants = list(node.peer_ids)
+        results: Dict[str, list] = {}
+        errors: List = []
+        done_evt = threading.Event()
+        lock = threading.Lock()
+
+        def mk_done(kt):
+            def _d(shares):
+                with lock:
+                    results[kt] = shares
+                    if len(results) == 2:
+                        done_evt.set()
+            return _d
+
+        def mk_err(kt):
+            def _e(err):
+                with lock:
+                    errors.append((kt, err))
+                done_evt.set()
+            return _e
+
+        sessions = []
+        try:
+            for kt in (wire.KEY_TYPE_SECP256K1, wire.KEY_TYPE_ED25519):
+                party = BatchedDKGParty(
+                    f"bdkg:{kt}:{batch_id}", node.node_id, participants,
+                    threshold, kt, B,
+                    preparams=(
+                        node.preparams
+                        if kt == wire.KEY_TYPE_SECP256K1
+                        else None
+                    ),
+                    min_paillier_bits=node.min_paillier_bits,
+                )
+                sessions.append(
+                    Session(
+                        session_id=f"bdkg:{kt}:{batch_id}",
+                        party=party,
+                        node_id=node.node_id,
+                        participants=participants,
+                        transport=self.transport,
+                        identity=node.identity,
+                        broadcast_topic=f"bdkg:broadcast:{kt}:{batch_id}",
+                        direct_topic_fn=(
+                            lambda n, kt=kt:
+                            f"bdkg:direct:{kt}:{n}:{batch_id}"
+                        ),
+                        on_done=mk_done(kt),
+                        on_error=mk_err(kt),
+                        hello_timeout_s=self.batch_patience_s,
+                        send_patience_s=self.batch_patience_s,
+                    )
+                )
+        except Exception as e:  # noqa: BLE001
+            log.error("batched DKG setup failed", batch=batch_id,
+                      error=str(e))
+            fail_all(str(e))
+            decline_both(str(e))
+            return
+        with self._lock:
+            if self._closed:
+                for w, _ in owned:
+                    self.on_kg_released(w)
+                return
+            self._sessions.extend(sessions)
+            self.batches_run += 1
+        for s in sessions:
+            s.listen()
+        finished = done_evt.wait(3600)
+        with self._lock:
+            for s in sessions:
+                if s in self._sessions:
+                    self._sessions.remove(s)
+        for s in sessions:
+            s.close()
+        if errors or not finished or len(results) != 2:
+            reason = (
+                "; ".join(f"{kt}: {e}" for kt, e in errors)
+                if errors else "batched keygen timed out"
+            )
+            log.error("batched DKG failed", batch=batch_id, reason=reason,
+                      node=node.node_id)
+            fail_all(reason)
+            return
+        secp = results[wire.KEY_TYPE_SECP256K1]
+        ed = results[wire.KEY_TYPE_ED25519]
+        for i, (msg, _r) in enumerate(reqs):
+            wid = msg.wallet_id
+            node.save_share(secp[i], wid)
+            node.save_share(ed[i], wid)
+            ev = wire.KeygenSuccessEvent(
+                wallet_id=wid,
+                ecdsa_pub_key=secp[i].public_key.hex(),
+                eddsa_pub_key=ed[i].public_key.hex(),
+            )
+            self.transport.queues.enqueue(
+                f"{wire.TOPIC_KEYGEN_RESULT}.{wid}",
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=wid,
+            )
+            if _entry_key("kg", msg) in owned:
+                self.on_kg_done(wid)
+        log.info("batched DKG complete", batch=batch_id, wallets=B,
+                 node=node.node_id)
+
+    # -- batched resharing (kind == "rs") ------------------------------------
+
+    def _on_reshare_manifest(self, batch_id: str, leader: str, reqs) -> None:
+        node = self.node
+        first = reqs[0][0]
+        info = node.keyinfo.get(first.key_type, first.wallet_id)
+        if info is None or sorted(info.participant_peer_ids)[0] != leader:
+            log.warn("reshare manifest from non-leader dropped",
+                     batch=batch_id, claimed=leader)
+            return
+        want = (
+            first.key_type, tuple(info.participant_peer_ids),
+            info.threshold, info.epoch, first.new_threshold,
+        )
+        for msg, _r in reqs:
+            winfo = node.keyinfo.get(msg.key_type, msg.wallet_id)
+            got = None if winfo is None else (
+                msg.key_type, tuple(winfo.participant_peer_ids),
+                winfo.threshold, winfo.epoch, msg.new_threshold,
+            )
+            if got != want:
+                log.warn("mixed-topology reshare manifest dropped",
+                         batch=batch_id, wallet=msg.wallet_id)
+                return
+            if not node.identity.verify_initiator(msg.raw(), msg.signature):
+                log.warn("reshare manifest with BAD initiator signature "
+                         "dropped", batch=batch_id)
+                return
+        covered = {_entry_key("rs", m) for m, _ in reqs}
+        inherited = self._inherit_covered("rs", covered)
+        threading.Thread(
+            target=self._run_reshare_batch,
+            args=(batch_id, reqs, info, inherited),
+            name=f"brs-{batch_id}", daemon=True,
+        ).start()
+
+    def _run_reshare_batch(
+        self, batch_id: str, reqs, info, inherited=()
+    ) -> None:
+        from ..node.node import share_key
+        from ..protocol.batch_dkg import BatchedReshareParty
+        from ..store.keyinfo import KeyInfo
+
+        node = self.node
+        first = reqs[0][0]
+        kt = first.key_type
+        owned = set(inherited)
+        for msg, _r in reqs:
+            k = _entry_key("rs", msg)
+            if k not in owned and self.claim_rs(msg.key_type, msg.wallet_id):
+                owned.add(k)
+        if len(owned) < len(reqs):
+            # same rule as keygen: a reshare batch persists key material —
+            # never run it concurrently with a live per-session rotation of
+            # the same wallet (two independent re-deal polynomials both at
+            # epoch+1 would be indistinguishable to the epoch fence)
+            log.warn("reshare batch refused — lane owned by live fallback",
+                     batch=batch_id, node=node.node_id)
+            for w, _t in owned:
+                self.on_rs_released(kt, w.split(":", 1)[1])
+            self._decline_batch(
+                f"brs:{kt}:{batch_id}", f"brs:broadcast:{kt}:{batch_id}",
+                "lane owned by live fallback",
+            )
+            return
+
+        def emit_error(msg, reason: str):
+            ev = wire.ResharingSuccessEvent(
+                wallet_id=msg.wallet_id, new_threshold=msg.new_threshold,
+                key_type=msg.key_type, pub_key="",
+                result_type=wire.RESULT_ERROR, error_reason=reason,
+            )
+            self.transport.queues.enqueue(
+                f"{wire.TOPIC_RESHARING_RESULT}.{msg.wallet_id}",
+                wire.canonical_json(ev.to_json()),
+                idempotency_key=f"{msg.wallet_id}-{msg.key_type}-err",
+            )
+
+        def fail_all(reason: str):
+            # mpc:reshare is an ephemeral command (reference semantics)
+            for msg, _r in reqs:
+                if _entry_key("rs", msg) in owned:
+                    emit_error(msg, reason)
+                    self.on_rs_done(msg.key_type, msg.wallet_id)
+
+        try:
+            old_quorum = node._ready_quorum(
+                info.participant_peer_ids, info.threshold + 1
+            )[: info.threshold + 1]
+            new_committee = node.registry.ready_peers()
+            if len(new_committee) < first.new_threshold + 1:
+                raise NotEnoughParticipants(
+                    f"{len(new_committee)} ready < new threshold"
+                )
+            is_old = node.node_id in old_quorum
+            old_shares = None
+            pubs = []
+            for msg, _r in reqs:
+                winfo = node.keyinfo.get(kt, msg.wallet_id)
+                pubs.append(bytes.fromhex(winfo.public_key))
+            if is_old:
+                old_shares = []
+                for msg, _r in reqs:
+                    share = node.load_share(kt, msg.wallet_id)
+                    winfo = node.keyinfo.get(kt, msg.wallet_id)
+                    if share.epoch != winfo.epoch:
+                        raise NotEnoughParticipants("epoch fence (mid-reshare)")
+                    old_shares.append(share)
+            party = BatchedReshareParty(
+                f"brs:{kt}:{batch_id}", node.node_id, kt,
+                old_quorum, new_committee, first.new_threshold, len(reqs),
+                old_shares=old_shares, old_public_keys=pubs,
+                preparams=(
+                    node.preparams if kt == wire.KEY_TYPE_SECP256K1 else None
+                ),
+                min_paillier_bits=node.min_paillier_bits,
+                old_epoch=info.epoch,
+            )
+        except (ProtocolError, NotEnoughParticipants) as e:
+            log.warn("batched reshare not runnable", batch=batch_id,
+                     reason=str(e), node=node.node_id)
+            fail_all(str(e))
+            self._decline_batch(
+                f"brs:{kt}:{batch_id}", f"brs:broadcast:{kt}:{batch_id}",
+                str(e),
+            )
+            return
+
+        def on_done(new_shares):
+            new_epoch = info.epoch + 1
+            for i, (msg, _r) in enumerate(reqs):
+                wid = msg.wallet_id
+                if new_shares is not None:
+                    node.save_share(new_shares[i], wid)
+                elif party.is_old:
+                    # old-only member: superseded share — delete + point
+                    # keyinfo at the new topology (node.py persist_and_done)
+                    node.kvstore.delete(share_key(kt, wid))
+                    node.keyinfo.save(
+                        kt, wid,
+                        KeyInfo(
+                            participant_peer_ids=list(party.new_committee),
+                            threshold=party.t_new,
+                            is_reshared=True,
+                            public_key=pubs[i].hex(),
+                            vss_commitments=[],
+                            epoch=new_epoch,
+                        ),
+                    )
+                if new_shares is not None:
+                    ev = wire.ResharingSuccessEvent(
+                        wallet_id=wid, new_threshold=msg.new_threshold,
+                        key_type=kt,
+                        pub_key=new_shares[i].public_key.hex(),
+                    )
+                    self.transport.queues.enqueue(
+                        f"{wire.TOPIC_RESHARING_RESULT}.{wid}",
+                        wire.canonical_json(ev.to_json()),
+                        idempotency_key=f"{wid}-{kt}",
+                    )
+                if _entry_key("rs", msg) in owned:
+                    self.on_rs_done(kt, wid)
+            log.info("batched reshare complete", batch=batch_id,
+                     wallets=len(reqs), node=node.node_id)
+            _prune()
+
+        def on_error(e):
+            log.error("batched reshare failed", batch=batch_id,
+                      error=str(e), node=node.node_id)
+            fail_all(str(e))
+            _prune()
+
+        def _prune():
+            with self._lock:
+                if session in self._sessions:
+                    self._sessions.remove(session)
+            session.close()
+
+        session = Session(
+            session_id=f"brs:{kt}:{batch_id}",
+            party=party,
+            node_id=node.node_id,
+            participants=sorted(set(old_quorum) | set(new_committee)),
+            transport=self.transport,
+            identity=node.identity,
+            broadcast_topic=f"brs:broadcast:{kt}:{batch_id}",
+            direct_topic_fn=lambda n: f"brs:direct:{kt}:{n}:{batch_id}",
+            on_done=on_done,
+            on_error=on_error,
+            hello_timeout_s=self.batch_patience_s,
+            send_patience_s=self.batch_patience_s,
+        )
+        with self._lock:
+            if self._closed:
+                for w in list(owned):
+                    self.on_rs_released(kt, w[0].split(":", 1)[1])
+                return
+            self._sessions.append(session)
+            self.batches_run += 1
+        session.listen()
+
     def _run_batch(
-        self, batch_id: str, reqs: List[Tuple[wire.SignTxMessage, str]]
+        self,
+        batch_id: str,
+        reqs: List[Tuple[wire.SignTxMessage, str]],
+        inherited: List[Tuple[str, str]] = (),
     ) -> None:
         node = self.node
         first = reqs[0][0]
         info = node.keyinfo.get(first.key_type, first.wallet_id)
         if info is None:
             return
-        # claim lanes we don't already own (e.g. the manifest beat the
-        # pub/sub copy of the request to this node). Claims held by the
-        # normal _on_sign path for these txs also count as ours: the
-        # consumer routed them to submit(), so the batch is their owner.
-        # Only claims WE acquire (or that _on_sign routed to submit(), i.e.
-        # already covered by a manifest) belong to the batch; a claim held
-        # by a live per-session run (manifest raced the fallback) must not
-        # be finished/released by us — that run owns its own lifecycle.
-        owned: List[Tuple[str, str]] = []
+        # The batch owns two kinds of dedup claims: (a) claims inherited
+        # from entries the manifest pulled out of our local buckets (the
+        # consumer's _on_sign claimed, then routed to submit()), and
+        # (b) claims we acquire here for lanes the manifest beat the
+        # pub/sub copy of the request to. A claim that is neither — held by
+        # a live per-session run because the manifest raced the fallback —
+        # must not be finished/released by us; that run owns its lifecycle.
+        owned_set = set(inherited)
         for msg, _r in reqs:
-            if self.claim_tx(msg.wallet_id, msg.tx_id):
-                owned.append((msg.wallet_id, msg.tx_id))
+            k = (msg.wallet_id, msg.tx_id)
+            if k not in owned_set and self.claim_tx(*k):
+                owned_set.add(k)
+        owned = list(owned_set)
 
-        owned_set = set(owned)
-
-        def release_all():
+        def release_all(reason: str = ""):
             for w, t in owned:
                 self.on_tx_released(w, t)
+            # tell peers (possibly mid-compile at their hello barrier) we
+            # are not coming, so they fail retryably NOW
+            self._decline_batch(
+                f"bsign:{batch_id}", f"bsign:broadcast:{batch_id}", reason
+            )
 
         try:
             quorum = node._ready_quorum(
                 info.participant_peer_ids, info.threshold + 1
             )
-        except NotEnoughParticipants:
-            release_all()
+        except NotEnoughParticipants as e:
+            release_all(str(e))
             return  # no reply ⇒ durable redelivery retries
         if node.node_id not in quorum:
-            release_all()
+            release_all("not in quorum")
             return
         shares: List[KeygenShare] = []
         messages: List[bytes] = []
+        kt = first.key_type
         try:
             for msg, _r in reqs:
                 share = node.load_share(msg.key_type, msg.wallet_id)
@@ -310,25 +927,49 @@ class BatchSigningScheduler:
                     raise NotEnoughParticipants("epoch fence (mid-reshare)")
                 shares.append(share)
                 messages.append(msg.tx)
-            party = BatchedEDDSASigningParty(
-                f"bsign:{batch_id}", node.node_id, quorum, shares, messages
-            )
+            if kt == wire.KEY_TYPE_SECP256K1:
+                from ..engine.gg18_batch import Domains
+                from ..protocol.ecdsa.batch_signing import (
+                    BatchedECDSASigningParty,
+                )
+
+                party = BatchedECDSASigningParty(
+                    f"bsign:{batch_id}", node.node_id, quorum, shares,
+                    messages, dom=self.gg18_dom or Domains(),
+                )
+            else:
+                party = BatchedEDDSASigningParty(
+                    f"bsign:{batch_id}", node.node_id, quorum, shares,
+                    messages,
+                )
         except (ProtocolError, NotEnoughParticipants) as e:
             log.warn("batch not signable here — waiting for redelivery",
                      batch=batch_id, reason=str(e), node=node.node_id)
-            release_all()
+            release_all(str(e))
             return
 
         def on_done(result):
-            sigs, ok = result["signatures"], result["ok"]
+            ok = result["ok"]
             for i, (msg, reply) in enumerate(reqs):
-                if bool(ok[i]):
+                if bool(ok[i]) and kt == wire.KEY_TYPE_SECP256K1:
                     ev = wire.SigningResultEvent(
                         result_type=wire.RESULT_SUCCESS,
                         wallet_id=msg.wallet_id,
                         tx_id=msg.tx_id,
                         network_internal_code=msg.network_internal_code,
-                        signature=sigs[i].tobytes().hex(),
+                        r=result["r"][i].tobytes().hex(),
+                        s=result["s"][i].tobytes().hex(),
+                        signature_recovery=format(
+                            int(result["recovery"][i]), "02x"
+                        ),
+                    )
+                elif bool(ok[i]):
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        signature=result["signatures"][i].tobytes().hex(),
                     )
                 else:
                     ev = wire.SigningResultEvent(
@@ -378,6 +1019,8 @@ class BatchSigningScheduler:
             direct_topic_fn=lambda n: f"bsign:direct:{n}:{batch_id}",
             on_done=on_done,
             on_error=on_error,
+            hello_timeout_s=self.batch_patience_s,
+            send_patience_s=self.batch_patience_s,
         )
         with self._lock:
             if self._closed:

@@ -62,6 +62,14 @@ class EventConsumer:
                 on_tx_done=lambda w, t: self._finish(f"{w}-{t}"),
                 on_tx_released=lambda w, t: self._release(f"{w}-{t}"),
                 claim_tx=lambda w, t: self._claim(f"{w}-{t}"),
+                on_fallback_keygen=self._keygen_fallback,
+                on_kg_done=lambda w: self._finish(f"keygen-{w}"),
+                on_kg_released=lambda w: self._release(f"keygen-{w}"),
+                claim_kg=lambda w: self._claim(f"keygen-{w}"),
+                on_fallback_reshare=self._reshare_fallback,
+                on_rs_done=lambda kt, w: self._finish(f"reshare-{kt}-{w}"),
+                on_rs_released=lambda kt, w: self._release(f"reshare-{kt}-{w}"),
+                claim_rs=lambda kt, w: self._claim(f"reshare-{kt}-{w}"),
             )
 
     # -- lifecycle ----------------------------------------------------------
@@ -105,6 +113,19 @@ class EventConsumer:
         if not self._claim(dedup):
             log.info("duplicate keygen event ignored", wallet=wallet_id)
             return
+        # TPU batch path: coalesce concurrent wallet creations into one
+        # batched-DKG dispatch pair (consumers.batch_scheduler kind="kg")
+        if self.scheduler is not None and self.scheduler.submit_keygen(msg):
+            return
+        self._start_keygen_single(msg, dedup)
+
+    def _keygen_fallback(self, msg) -> None:
+        """Scheduler liveness fallback (keygen manifest never arrived):
+        per-wallet dual-curve sessions. The dedup claim is still held."""
+        self._start_keygen_single(msg, f"keygen-{msg.wallet_id}")
+
+    def _start_keygen_single(self, msg, dedup: str) -> None:
+        wallet_id = msg.wallet_id
         threshold = self._threshold()
         results: Dict[str, bytes] = {}
         errors: list = []
@@ -333,7 +354,19 @@ class EventConsumer:
         dedup = f"reshare-{msg.key_type}-{msg.wallet_id}"
         if not self._claim(dedup):
             return
+        # TPU batch path: coalesce concurrent rotations of one topology
+        # into a single batched re-deal (consumers.batch_scheduler "rs")
+        if self.scheduler is not None and self.scheduler.submit_reshare(msg):
+            return
+        self._start_reshare_single(msg, dedup)
 
+    def _reshare_fallback(self, msg) -> None:
+        """Scheduler liveness fallback (reshare manifest never arrived)."""
+        self._start_reshare_single(
+            msg, f"reshare-{msg.key_type}-{msg.wallet_id}"
+        )
+
+    def _start_reshare_single(self, msg, dedup: str) -> None:
         def on_done(share):
             try:
                 if share is None:

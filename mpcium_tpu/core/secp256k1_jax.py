@@ -226,3 +226,34 @@ def pack_be_32(limbs: jnp.ndarray) -> jnp.ndarray:
     by = bits.reshape(bits.shape[:-1] + (32, 8))
     vals = jnp.sum(by << jnp.arange(8, dtype=jnp.int32), axis=-1)
     return jnp.flip(vals, axis=-1).astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=None)
+def _sqrt_ctx():
+    from .fields import Secp256k1Sqrt
+
+    return Secp256k1Sqrt()
+
+
+def decompress(b: jnp.ndarray):
+    """Batch SEC1 decompression: (..., 33) uint8 → (SecpPointJ, ok mask).
+
+    Bad encodings (wrong tag, x ≥ p, non-residue) yield ok=False with an
+    arbitrary valid-shape point — callers gate on the mask (the device
+    analogue of hostmath.secp_decompress raising)."""
+    F = secp256k1_field()
+    tag = b[..., 0].astype(jnp.int32)
+    xb = jnp.flip(b[..., 1:], axis=-1)  # big-endian bytes → little-endian
+    x = bn.bytes_to_limbs_le(xb, PROF, PROF.n_limbs)
+    p_l = jnp.broadcast_to(jnp.asarray(bn.to_limbs(hm.SECP_P, PROF)), x.shape)
+    ok = (bn.compare(x, p_l) < 0) & ((tag == 2) | (tag == 3))
+    rhs = F.add(F.mul(F.square(x), x), F.const(7, x.shape[:-1]))
+    y, has_root = _sqrt_ctx().sqrt(rhs)
+    ok = ok & has_root
+    y = F.canonical(y)
+    flip = (y[..., 0] & 1) != (tag & 1)
+    y = jnp.where(flip[..., None], F.canonical(F.neg(y)), y)
+    one = jnp.broadcast_to(
+        jnp.asarray(bn.to_limbs(1, PROF)), x.shape
+    )
+    return SecpPointJ(x, y, one), ok

@@ -37,6 +37,7 @@ test_gg18_full_size.
 from __future__ import annotations
 
 import functools
+import os
 import secrets
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -55,9 +56,56 @@ from ..ops import modmul as mm
 from ..ops.paillier_mxu import RAND_BITS, PaillierMXUPrivate
 from ..ops.sha256 import sha256 as dev_sha256
 from ..protocol.base import KeygenShare, party_xs
+from ..utils import log
 
 Q = hm.SECP_N
 SCALAR_BITS = 256
+
+# Randomized batch verification (Bellare–Garay–Rabin small-exponent test)
+# for the s^N ciphertext legs: instead of one 2048-bit-exponent modexp per
+# session per leg (~2560 sequential mulmod steps over the batch), the
+# verifier samples per-session 128-bit ρ_b and checks ONE combined
+# equation, using Π_b s_b^{ρ_b·N} = (Π_b s_b^{ρ_b})^N and
+# Π_b (1+s1_b·N)^{ρ_b} = 1 + (Σ_b ρ_b·s1_b)·N mod N². Per-element cost
+# drops to one 128-bit modexp (+ log-depth folds + one single-value host
+# modexp). On combined-check failure the verifier falls back to strict
+# per-session verification, so a bad proof is still attributed to its
+# session (identifiable abort). Soundness: 2^-128 for deviations of odd
+# order in Z_{N²}*; see SECURITY.md for the even-order caveat.
+# MPCIUM_BATCH_VERIFY=strict restores reference-equivalent per-session
+# verification.
+BATCH_VERIFY = os.environ.get("MPCIUM_BATCH_VERIFY", "rand")
+RHO_BITS = 128
+
+
+def _fold_add(x: jnp.ndarray, extra_limbs: int = 3) -> jnp.ndarray:
+    """Σ over the batch axis of normalized 7-bit limb tensors → (1, n+extra)
+    normalized limbs. Exact while B·127 < 2²⁴ (B ≤ ~131k)."""
+    assert x.shape[0] <= (1 << 17)
+    x = bn.pad_limbs(x, extra_limbs)
+    return mm.carry(jnp.sum(x, axis=0, keepdims=True))
+
+
+def _host_pow_single(x_limbs: jnp.ndarray, exp: int, ctx) -> jnp.ndarray:
+    """(1, n) limbs → x^exp mod ctx.modulus via one host bigint modexp
+    (a single 2048-bit-exponent value: device scan would serialize ~2.5k
+    tiny dispatches; CPython pow is milliseconds)."""
+    v = bn.batch_from_limbs(np.asarray(x_limbs), ctx.prof)[0]
+    return jnp.asarray(
+        bn.batch_to_limbs([pow(v, exp, ctx.modulus)], ctx.prof)
+    )
+
+
+def _host_pow_batch(x_limbs: jnp.ndarray, exp: int, ctx) -> jnp.ndarray:
+    """(B, n) limbs → x^exp per element on HOST. Only the strict-fallback
+    (attack/abort-attribution) path uses this: the full-width-exponent
+    device kernel it replaces is exactly the executable that crashes XLA's
+    CPU AOT cache serializer on this class of host, and the fallback is
+    cold by construction."""
+    vals = bn.batch_from_limbs(np.asarray(x_limbs), ctx.prof)
+    return jnp.asarray(
+        bn.batch_to_limbs([pow(v, exp, ctx.modulus) for v in vals], ctx.prof)
+    )
 
 
 @dataclass(frozen=True)
@@ -144,20 +192,46 @@ def _eq_all(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 class PartyCtx:
-    """One signer's static crypto material + device contexts."""
+    """One signer's static crypto material + device contexts.
+
+    The normal constructor holds the party's PRIVATE material (own
+    PreParams). :meth:`public` builds a peer's context from the public
+    material exchanged at keygen (peer_paillier / peer_ring_pedersen in
+    the share aux) — everything MtaBatch needs from the *other* side of a
+    pair: encryption under the peer's N (with a locally-chosen randomizer
+    base y), ring-Pedersen commitments in the peer's NTilde, and the
+    verification contexts. Decryption obviously stays private-only.
+    """
 
     def __init__(self, pid: str, pre: PreParams, rng=secrets):
         self.pid = pid
         self.pre = pre
         self.pmx = PaillierMXUPrivate(pre.paillier, rng=rng)
-        self.N = pre.paillier.N
-        self.NTilde = pre.NTilde
-        self.ctx_nt = mm.MXUBarrett(self.NTilde)
-        self.h1 = pre.h1
-        self.h2 = pre.h2
-        self.nt_bytes = -(-self.NTilde.bit_length() // 8)
-        self.n2_bytes = -(-(2 * self.N.bit_length()) // 8)
-        self.n_bytes = -(-self.N.bit_length() // 8)
+        self._common(pre.paillier.N, pre.NTilde, pre.h1, pre.h2)
+
+    @classmethod
+    def public(
+        cls, pid: str, N: int, NTilde: int, h1: int, h2: int, rng=secrets
+    ) -> "PartyCtx":
+        from ..core.paillier import PaillierPublicKey
+        from ..ops.paillier_mxu import PaillierMXU
+
+        obj = cls.__new__(cls)
+        obj.pid = pid
+        obj.pre = None
+        obj.pmx = PaillierMXU(PaillierPublicKey(N), rng=rng)
+        obj._common(N, NTilde, h1, h2)
+        return obj
+
+    def _common(self, N: int, NTilde: int, h1: int, h2: int) -> None:
+        self.N = N
+        self.NTilde = NTilde
+        self.ctx_nt = mm.MXUBarrett(NTilde)
+        self.h1 = h1
+        self.h2 = h2
+        self.nt_bytes = -(-NTilde.bit_length() // 8)
+        self.n2_bytes = -(-(2 * N.bit_length()) // 8)
+        self.n_bytes = -(-N.bit_length() // 8)
 
     def commit_ring(self, m_bits: jnp.ndarray, r_bits: jnp.ndarray) -> jnp.ndarray:
         """h1^m · h2^r mod NTilde — two comb-table fixed-base exps."""
@@ -306,7 +380,7 @@ class MtaBatch:
             return self.e_limbs(e)
         return e
 
-    def bob_check_alice(self, c_a, T, P, e) -> jnp.ndarray:
+    def bob_check_alice(self, c_a, T, P, e, rng=secrets) -> jnp.ndarray:
         """Batched Alice-proof verification → (B,) bool."""
         A, Bo = self.alice, self.bob
         e_l = self.e_limbs_from(e)
@@ -315,24 +389,54 @@ class MtaBatch:
         )
         ok = bn.compare(P["s1"], q3) <= 0
         e_bits = _bits_of(e_l, self.p_e, self.dom.scalar)
-        n2 = A.pmx.ctx_N2
         s1_modN = A.pmx.ctx_N.reduce(
             bn.take_limbs(P["s1"], 0, min(P["s1"].shape[-1], 2 * A.pmx.prof_n.n_limbs))
         )
-        lhs = n2.mulmod(
-            A.pmx.enc_deterministic(s1_modN),
-            n2.powmod_const_exp(
-                bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N
-            ),
-        )
-        rhs = n2.mulmod(T["u"], n2.powmod(c_a, e_bits))
-        ok = ok & _eq_all(lhs, rhs)
+        ok = ok & self._alice_enc_leg(c_a, T, P, e_bits, s1_modN, rng)
         lhs2 = Bo.commit_ring(
             _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7),
             _bits_of(P["s2"], self.p_s2, self.p_s2.n_limbs * 7),
         )
         rhs2 = Bo.ctx_nt.mulmod(T["w"], Bo.ctx_nt.powmod(T["z"], e_bits))
         return ok & _eq_all(lhs2, rhs2)
+
+    def _alice_enc_leg_strict(self, c_a, T, P, e_bits, s1_modN) -> jnp.ndarray:
+        """Per-session ciphertext-leg check:
+        Enc_det(s1)·s^N == u·c_a^e (mod N²). The s^N piece runs on host
+        (see _host_pow_batch)."""
+        A = self.alice
+        n2 = A.pmx.ctx_N2
+        lhs = n2.mulmod(
+            A.pmx.enc_deterministic(s1_modN),
+            _host_pow_batch(
+                bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N, n2
+            ),
+        )
+        rhs = n2.mulmod(T["u"], n2.powmod(c_a, e_bits))
+        return _eq_all(lhs, rhs)
+
+    def _alice_enc_leg(self, c_a, T, P, e_bits, s1_modN, rng) -> jnp.ndarray:
+        """Ciphertext leg of the Alice proof, batch-verified (module
+        docstring at BATCH_VERIFY): Enc_det(Σρ·s1) · (Πs^ρ)^N ==
+        Π(u·c_a^e)^ρ. Strict per-session fallback attributes failures."""
+        if BATCH_VERIFY != "rand":
+            return self._alice_enc_leg_strict(c_a, T, P, e_bits, s1_modN)
+        A = self.alice
+        n2 = A.pmx.ctx_N2
+        B = s1_modN.shape[0]
+        rho_bits = rand_bit_tensor(B, RHO_BITS, rng)
+        rhs = n2.mulmod(T["u"], n2.powmod(c_a, e_bits))
+        Rp = n2.prod_over_batch(n2.powmod(rhs, rho_bits))[None]
+        s2 = bn.take_limbs(P["s"], 0, n2.prof.n_limbs)
+        Sp = n2.prod_over_batch(n2.powmod(s2, rho_bits))[None]
+        SN = _host_pow_single(Sp, A.N, n2)
+        rho_l = _bits_pack(rho_bits, _prof7(RHO_BITS))
+        tot = A.pmx.ctx_N.reduce(_fold_add(mm.mul_pair(rho_l, s1_modN)))
+        lhs = n2.mulmod(A.pmx.enc_deterministic(tot), SN)
+        if bool(np.asarray(_eq_all(lhs, Rp))[0]):
+            return jnp.ones((B,), bool)
+        log.warn("batched Alice-proof check failed — strict re-verification")
+        return self._alice_enc_leg_strict(c_a, T, P, e_bits, s1_modN)
 
     # -- Bob: homomorphic response + proof ----------------------------------
 
@@ -419,7 +523,7 @@ class MtaBatch:
         )
         return {"s": s, "s1": s1, "s2": s2, "t1": t1, "t2": t2}
 
-    def alice_check_bob(self, c_a, T, P, e) -> jnp.ndarray:
+    def alice_check_bob(self, c_a, T, P, e, rng=secrets) -> jnp.ndarray:
         """Batched Bob-proof verification (ciphertext + ring legs; the
         with-check curve leg is checked by the caller)."""
         A = self.alice
@@ -451,16 +555,24 @@ class MtaBatch:
         t1_modN = A.pmx.ctx_N.reduce(
             bn.take_limbs(P["t1"], 0, min(P["t1"].shape[-1], 2 * A.pmx.prof_n.n_limbs))
         )
-        lhs = n2.mulmod(
-            n2.mulmod(
-                n2.powmod(c_a, _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7)),
-                A.pmx.enc_deterministic(t1_modN),
-            ),
-            n2.powmod_const_exp(
-                bn.take_limbs(P["s"], 0, n2.prof.n_limbs), A.N
-            ),
+        # ciphertext leg: c_a^s1 · Enc_det(t1) · s^N == v · c_b^e (mod N²)
+        M = n2.mulmod(
+            n2.powmod(c_a, _bits_of(P["s1"], self.p_s1, self.p_s1.n_limbs * 7)),
+            A.pmx.enc_deterministic(t1_modN),
         )
         rhs = n2.mulmod(T["v"], n2.powmod(T["c_b"], e_bits))
+        s_lift = bn.take_limbs(P["s"], 0, n2.prof.n_limbs)
+        if BATCH_VERIFY == "rand":
+            B = s_lift.shape[0]
+            rho_bits = rand_bit_tensor(B, RHO_BITS, rng)
+            Mp = n2.prod_over_batch(n2.powmod(M, rho_bits))[None]
+            Sp = n2.prod_over_batch(n2.powmod(s_lift, rho_bits))[None]
+            Rp = n2.prod_over_batch(n2.powmod(rhs, rho_bits))[None]
+            SN = _host_pow_single(Sp, A.N, n2)
+            if bool(np.asarray(_eq_all(n2.mulmod(Mp, SN), Rp))[0]):
+                return ok
+            log.warn("batched Bob-proof check failed — strict re-verification")
+        lhs = n2.mulmod(M, _host_pow_batch(s_lift, A.N, n2))
         return ok & _eq_all(lhs, rhs)
 
     def alice_decrypt_share(self, c_b) -> jnp.ndarray:
@@ -616,6 +728,100 @@ def _blk_schnorr(kpok_i, gamma_i, Gamma_i, comp_i, idx):
         sp.scalar_mul(bn.limbs_to_bits(e, P256, SCALAR_BITS), Gamma_i),
     )
     return _eq_all(sp.compress(lhs), A_comp)
+
+
+# -- prover/verifier split variants of the PoK blocks (the distributed
+# protocol sends proofs across the transport; the in-process fabric keeps
+# the fused prove+self-verify blocks above) --------------------------------
+
+
+@jax.jit
+def _blk_schnorr_prove(kpok_i, gamma_i, comp_i, idx):
+    """Schnorr PoK of γ_i, prover side → (A_comp, s_pok)."""
+    ring = sp.scalar_ring()
+    A_pt = sp.base_mul(bn.limbs_to_bits(kpok_i, P256, SCALAR_BITS))
+    A_comp = sp.compress(A_pt)
+    e32 = dev_hash(b"schnorr", idx, A_comp, comp_i)
+    e = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    s_pok = ring.submod(kpok_i, ring.mulmod(e, gamma_i))
+    return A_comp, s_pok
+
+
+@jax.jit
+def _blk_schnorr_verify(A_comp, s_pok, Gamma_i: sp.SecpPointJ, comp_i, idx):
+    """Schnorr PoK verify: s·G + e·Γ ?= A → (B,) bool."""
+    ring = sp.scalar_ring()
+    e32 = dev_hash(b"schnorr", idx, A_comp, comp_i)
+    e = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    lhs = sp.add(
+        sp.base_mul(bn.limbs_to_bits(s_pok, P256, SCALAR_BITS)),
+        sp.scalar_mul(bn.limbs_to_bits(e, P256, SCALAR_BITS), Gamma_i),
+    )
+    return _eq_all(sp.compress(lhs), A_comp)
+
+
+@jax.jit
+def _blk_pedersen_prove(ka, kb, s_i, l_i, R_pt, vc, ac, idx):
+    """Phase-5B PedersenPoK of (s_i, l_i), prover side →
+    (Apok_comp, sa, sb)."""
+    ring = sp.scalar_ring()
+    Apok = sp.add(
+        sp.scalar_mul(bn.limbs_to_bits(ka, P256, SCALAR_BITS), R_pt),
+        sp.base_mul(bn.limbs_to_bits(kb, P256, SCALAR_BITS)),
+    )
+    Apok_comp = sp.compress(Apok)
+    e32 = dev_hash(b"pedersen", idx, Apok_comp, vc, ac)
+    e5 = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    sa = ring.submod(ka, ring.mulmod(e5, s_i))
+    sb = ring.submod(kb, ring.mulmod(e5, l_i))
+    return Apok_comp, sa, sb
+
+
+@jax.jit
+def _blk_pedersen_verify(Apok_comp, sa, sb, V_i: sp.SecpPointJ, R_pt, vc, ac, idx):
+    """Phase-5B PedersenPoK verify: sa·R + sb·G + e·V ?= Apok."""
+    ring = sp.scalar_ring()
+    e32 = dev_hash(b"pedersen", idx, Apok_comp, vc, ac)
+    e5 = ring.reduce(bn.bytes_to_limbs_le(e32, P256, 22))
+    lhs = sp.add(
+        sp.add(
+            sp.scalar_mul(bn.limbs_to_bits(sa, P256, SCALAR_BITS), R_pt),
+            sp.base_mul(bn.limbs_to_bits(sb, P256, SCALAR_BITS)),
+        ),
+        sp.scalar_mul(bn.limbs_to_bits(e5, P256, SCALAR_BITS), V_i),
+    )
+    return _eq_all(sp.compress(lhs), Apok_comp)
+
+
+@jax.jit
+def _blk_va_check(blind_i, vc, ac, idx, commit):
+    """Phase-5B decommit check of a peer's (V_c, A_c) commitment."""
+    return _eq_all(dev_hash(b"VA", idx, blind_i, vc, ac), commit)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _blk_W_from_vss(C_comp, xj: int, lam_bits):
+    """W_j = λ_j · Σ_k x_j^k · C_k from aggregated VSS commitments.
+
+    ``C_comp``: (t+1, B, 33) compressed commitment points (wallet order),
+    ``xj``: the party's Shamir x (static small int), ``lam_bits``: (256,)
+    LSB-first bits of λ_j (shared across the batch; an operand so one
+    executable serves every quorum). Returns (W points, ok mask)."""
+    pts, ok_all = sp.decompress(C_comp)
+    ok = jnp.all(ok_all, axis=0)
+    t1 = C_comp.shape[0]
+    acc = sp.SecpPointJ(pts.X[t1 - 1], pts.Y[t1 - 1], pts.Z[t1 - 1])
+    nb = max(1, xj.bit_length())
+    xj_bits = jnp.asarray(sp.scalars_to_bits([xj], n_bits=nb)[0])
+    for k in range(t1 - 2, -1, -1):
+        acc = sp.scalar_mul(
+            jnp.broadcast_to(xj_bits, acc.X.shape[:-1] + (nb,)), acc
+        )
+        acc = sp.add(acc, sp.SecpPointJ(pts.X[k], pts.Y[k], pts.Z[k]))
+    W = sp.scalar_mul(
+        jnp.broadcast_to(lam_bits, acc.X.shape[:-1] + (SCALAR_BITS,)), acc
+    )
+    return W, ok
 
 
 @jax.jit
@@ -872,7 +1078,9 @@ class GG18BatchCoSigners:
         for (a, b) in self.pairs:
             mta = self.mta[(a, b)]
             st = mta_state[(a, b)]
-            ok = ok & mta.bob_check_alice(c_k[a], st["T"], st["P"], st["e"])
+            ok = ok & mta.bob_check_alice(
+                c_k[a], st["T"], st["P"], st["e"], rng=self.rng
+            )
             for name, secret in (("gamma", gamma[b]), ("w", self.w[b])):
                 Rb = mta.bob_randoms(B, self.rng)
                 b_e = _scalar_to_prof(secret, mta.p_e)
@@ -900,7 +1108,7 @@ class GG18BatchCoSigners:
             for name in ("gamma", "w"):
                 sub = st[name]
                 ok = ok & mta.alice_check_bob(
-                    c_k[a], sub["Tb"], sub["Pb"], sub["e"]
+                    c_k[a], sub["Tb"], sub["Pb"], sub["e"], rng=self.rng
                 )
                 if name == "w":
                     # with-check: s1·G ?= U + e·W_b (one fused dispatch)
@@ -1026,18 +1234,54 @@ def dealer_keygen_secp_batch(
     party_ids: Sequence[str],
     threshold: int,
     rng=secrets,
+    preparams: Optional[Dict[str, PreParams]] = None,
 ) -> List[List[KeygenShare]]:
     """Trusted-dealer batch keygen for tests/bench setup ONLY — production
     wallets come from protocol.ecdsa.keygen. result[i] belongs to
-    party_ids[i], wallet order aligned."""
+    party_ids[i], wallet order aligned.
+
+    With ``preparams``, shares also carry the keygen aux material
+    (paillier/ring-Pedersen maps + VSS commitments) that the distributed
+    signing parties (per-session and batched) consume."""
     xs = party_xs(party_ids)
     out: List[List[KeygenShare]] = [[] for _ in party_ids]
+    aux_by_pid: Dict[str, Dict] = {}
+    if preparams is not None:
+        for pid in party_ids:
+            pre = preparams[pid]
+            aux_by_pid[pid] = {
+                "paillier_sk": pre.paillier.to_json(),
+                "preparams": {
+                    "ntilde": str(pre.NTilde),
+                    "h1": str(pre.h1),
+                    "h2": str(pre.h2),
+                },
+                "peer_paillier": {
+                    p: str(preparams[p].paillier.N)
+                    for p in party_ids
+                    if p != pid
+                },
+                "peer_ring_pedersen": {
+                    p: {
+                        "ntilde": str(preparams[p].NTilde),
+                        "h1": str(preparams[p].h1),
+                        "h2": str(preparams[p].h2),
+                    }
+                    for p in party_ids
+                    if p != pid
+                },
+            }
     for _ in range(n_wallets):
         secret = rng.randbelow(Q - 1) + 1
-        _, shares = hm.shamir_share(
+        coeffs, shares = hm.shamir_share(
             secret, threshold, [xs[p] for p in party_ids], Q, rng=rng
         )
         pub = hm.secp_compress(hm.secp_mul(secret, hm.SECP_G))
+        vss = (
+            [hm.secp_compress(hm.secp_mul(c, hm.SECP_G)) for c in coeffs]
+            if preparams is not None
+            else []
+        )
         for i, pid in enumerate(party_ids):
             out[i].append(
                 KeygenShare(
@@ -1045,8 +1289,10 @@ def dealer_keygen_secp_batch(
                     share=shares[xs[pid]],
                     self_x=xs[pid],
                     public_key=pub,
+                    vss_commitments=list(vss),
                     participants=sorted(party_ids),
                     threshold=threshold,
+                    aux=aux_by_pid.get(pid, {}),
                 )
             )
     return out

@@ -80,6 +80,7 @@ def run_node(
     transport = tcp_transport(
         cfg.broker_host, cfg.broker_port,
         auth_token=cfg.broker_token or None,
+        encrypt=cfg.broker_encrypt,
     )
     registry = PeerRegistry(name, list(peers), control_kv)
     node = Node(
@@ -129,6 +130,7 @@ def run_broker(
     block: bool = True,
     journal: str = "",
     token: str = "",
+    encrypt: bool = False,
 ):
     """The `nats-server` analogue: `mpcium-tpu broker`. CLI flags win;
     otherwise config.yaml's broker_journal/broker_token apply."""
@@ -140,6 +142,7 @@ def run_broker(
         host=host, port=port,
         journal_path=journal or cfg.broker_journal or None,
         auth_token=token or cfg.broker_token or None,
+        encrypt=encrypt or cfg.broker_encrypt,
     )
     log.init()
     log.info("broker listening", host=broker.host, port=broker.port)

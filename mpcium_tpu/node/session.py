@@ -21,7 +21,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..identity.identity import IdentityStore
 from ..protocol.base import PartyBase, ProtocolError, RoundMsg
-from ..transport.api import Transport
+from ..transport.api import Transport, TransportError
 from ..utils import log
 from ..wire import Envelope
 
@@ -61,6 +61,7 @@ class Session:
         on_done: Optional[Callable[[object], None]] = None,
         on_error: Optional[Callable[[Exception], None]] = None,
         hello_timeout_s: Optional[float] = 20.0,
+        send_patience_s: float = 0.0,
     ):
         self.session_id = session_id
         self.party = party
@@ -82,7 +83,21 @@ class Session:
         self.last_activity = self.created_at
         self._done_evt = threading.Event()
         self.hello_timeout_s = hello_timeout_s
+        # extra unicast retry budget on TOP of the transport's own
+        # (3 s × 3 attempts, reference point2point.go:26-45). Batched
+        # DKG/signing sessions set this generously: a peer can be busy for
+        # minutes inside one round (XLA compiles, DLN verification) and an
+        # unacked send then means "receiver busy", not "receiver gone".
+        self.send_patience_s = send_patience_s
         self._hello_timer: Optional[threading.Timer] = None
+        # unicasts go through a dedicated sender thread: an acked send can
+        # block for the whole patience budget, and doing that INSIDE a
+        # transport handler thread deadlocks the delivery pools (every
+        # worker waiting on a peer whose workers are likewise stuck)
+        import queue as _queue
+
+        self._out_q: "_queue.Queue" = _queue.Queue()
+        self._sender: Optional[threading.Thread] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -97,6 +112,12 @@ class Session:
                 self.direct_topic_fn(self.node_id), self._on_raw
             )
         )
+        self._sender = threading.Thread(
+            target=self._send_loop,
+            name=f"send-{self.session_id[:24]}",
+            daemon=True,
+        )
+        self._sender.start()
         self._send_hello()
         # barrier deadline: a never-arriving quorum peer must fail the
         # session RETRYABLY within the signing window, not sit buffered
@@ -135,6 +156,9 @@ class Session:
             except Exception:  # noqa: BLE001
                 pass
         self._subs.clear()
+        # sentinel: the sender drains already-queued unicasts (peers may
+        # still need them) and exits
+        self._out_q.put(None)
 
     def wait(self, timeout_s: float) -> bool:
         return self._done_evt.wait(timeout_s)
@@ -159,6 +183,29 @@ class Session:
         self.identity.sign_envelope(env)
         self.transport.pubsub.publish(self.broadcast_topic, env.encode())
 
+    @staticmethod
+    def send_decline(
+        transport: Transport,
+        identity: IdentityStore,
+        node_id: str,
+        session_id: str,
+        broadcast_topic: str,
+        reason: str = "",
+    ) -> None:
+        """Signed 'not joining' announcement for a session this node will
+        never create (e.g. a batch it cannot serve yet). Peers waiting at
+        the hello barrier fail RETRYABLY at once instead of burning their
+        hello deadline — essential once deadlines are generous enough to
+        ride out long compiles (send_patience_s)."""
+        env = Envelope(
+            session_id=session_id,
+            round=HELLO_ROUND,
+            from_id=node_id,
+            payload={"bye": True, "reason": reason},
+        )
+        identity.sign_envelope(env)
+        transport.pubsub.publish(broadcast_topic, env.encode())
+
     def _route(self, msgs: Sequence[RoundMsg]) -> None:
         for m in msgs:
             env = Envelope(
@@ -174,9 +221,31 @@ class Session:
             if m.is_broadcast:
                 self.transport.pubsub.publish(self.broadcast_topic, raw)
             else:
-                # acked unicast with retry (reference session.go:126,
-                # point2point.go:26-45)
-                self.transport.direct.send(self.direct_topic_fn(m.to), raw)
+                # acked unicast, via the sender thread (see __init__ note)
+                self._out_q.put((m.to, raw))
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._out_q.get()
+            if item is None:
+                return
+            to, raw = item
+            # acked unicast (reference session.go:126, point2point.go:
+            # 26-45). With patience, the WHOLE budget rides one transport
+            # call: one delivery, waited on — never re-delivered to a busy
+            # receiver (duplicate floods starve shared delivery pools)
+            try:
+                if self.send_patience_s > 0:
+                    self.transport.direct.send(
+                        self.direct_topic_fn(to), raw,
+                        timeout_s=self.send_patience_s,
+                    )
+                else:
+                    self.transport.direct.send(self.direct_topic_fn(to), raw)
+            except TransportError as e:
+                if not self._failed and not self.party.done:
+                    self._fail(e)
+                return
 
     # -- inbound ------------------------------------------------------------
 
@@ -200,6 +269,21 @@ class Session:
                      session=self.session_id, sender=env.from_id)
             return
         if env.round == HELLO_ROUND:
+            if env.payload.get("bye"):
+                with self._lock:
+                    if self._started or self._failed:
+                        return
+                    self._failed = True
+                if self._hello_timer is not None:
+                    self._hello_timer.cancel()
+                self.close()
+                if self.on_error:
+                    self.on_error(RetryableSessionError(
+                        f"peer {env.from_id} declined session "
+                        f"{self.session_id!r}: "
+                        f"{env.payload.get('reason', '')}"
+                    ))
+                return
             self._on_hello(env.from_id)
             return
         msg = RoundMsg(

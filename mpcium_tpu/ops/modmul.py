@@ -170,11 +170,17 @@ def mul_const(x: jnp.ndarray, T: jnp.ndarray) -> jnp.ndarray:
 
 import os
 
-# Pairwise-product strategy: "i32" (blocked int32 einsum — the measured
-# baseline) or "bf16" (same block structure with bf16 multiplicands and f32
-# accumulation — exact for 7-bit limbs, and a candidate to hit the MXU's
-# native bf16 path; flip via MPCIUM_MULPAIR once measured on the chip).
-MULPAIR_STRATEGY = os.environ.get("MPCIUM_MULPAIR", "i32")
+# Pairwise-product strategy: "bf16" (default — blocked einsum with bf16
+# multiplicands and f32 accumulation, exact for 7-bit limbs, rides the
+# MXU's native bf16 path) or "i32" (the round-3 blocked int32 einsum,
+# kept as an escape hatch / differential-test oracle via MPCIUM_MULPAIR).
+MULPAIR_STRATEGY = os.environ.get("MPCIUM_MULPAIR", "bf16")
+
+# Largest block count for which the bf16 overlap-add stays f32-exact:
+# each 32-limb block-product column is ≤ 32·127² = 516,128 and the
+# overlap-add at any output block sums ≤ min(bx, by) columns, so
+# min(bx, by) ≤ 32 keeps every partial sum ≤ 16,516,096 < 2²⁴.
+_BF16_MAX_BLOCKS = 32
 
 
 def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
@@ -182,11 +188,21 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
     Exactness: normalized 7-bit limbs (≤127) are exact bf16 values; a
     32-limb block-product column is ≤ 32·127² < 2²⁴ (f32-exact), and the
-    overlap-add sums ≤ 19 such columns < 2²⁴. Requires NORMALIZED inputs
-    (the i32 path tolerates mildly redundant limbs; this one does not).
+    overlap-add sums ≤ min(bx, by) ≤ 32 such columns < 2²⁴. Requires
+    NORMALIZED inputs (the i32 path tolerates mildly redundant limbs;
+    this one does not).
     """
     n_x, n_y = x.shape[-1], y.shape[-1]
     bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
+    if min(bx, by) > _BF16_MAX_BLOCKS:
+        # a hard error, not an assert: this guards cryptographic
+        # correctness and must survive `python -O`
+        raise ValueError(
+            f"bf16 pairwise product overlap-add would exceed 2^24 "
+            f"exactness: min({bx}, {by}) blocks > {_BF16_MAX_BLOCKS} "
+            f"(operands up to {_BF16_MAX_BLOCKS * _BLOCK * LIMB_BITS} "
+            f"bits); use MPCIUM_MULPAIR=i32 for wider operands"
+        )
     xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
         x.shape[:-1] + (bx, _BLOCK)
     ).astype(jnp.bfloat16)

@@ -21,9 +21,17 @@ drives the generic 11-bit einsum path with full-width exponents):
 Reference correspondence: tss-lib's paillier.{EncryptAndReturnRandomness,
 Decrypt} under the GG18 rounds (SURVEY.md §2.3); the per-session Go path
 becomes one fused dispatch over the session batch.
+
+Security note (SECURITY.md "Cryptographic assumptions of the batched
+engine"): the short-randomizer optimization adds a short-exponent/
+subgroup-sampling assumption on top of DCR. ``MPCIUM_PAILLIER_RAND_BITS``
+widens the exponent (e.g. 2176 ≥ |N|+128 for statistical uniformity over
+⟨y⟩); the per-session protocol path keeps reference-equivalent uniform
+randomizers.
 """
 from __future__ import annotations
 
+import os
 import secrets
 from typing import Optional, Tuple
 
@@ -34,7 +42,9 @@ from ..core import bignum as bn
 from ..core.paillier import PaillierPrivateKey, PaillierPublicKey
 from . import modmul as mm
 
-RAND_BITS = 256  # short-randomizer exponent width (2 × 128-bit security)
+# short-randomizer exponent width (2 x 128-bit security); widen via env to
+# trade speed for a weaker sampling assumption (SECURITY.md)
+RAND_BITS = int(os.environ.get("MPCIUM_PAILLIER_RAND_BITS", "256"))
 
 
 class PaillierMXU:

@@ -1,0 +1,665 @@
+"""Distributed batched GG18 threshold-ECDSA signing: ONE protocol instance
+signs B wallets' digests concurrently.
+
+This is the secp256k1 face of the TPU batch engine (SURVEY.md §7.2 step 5)
+— the distributed counterpart of the in-process measurement fabric
+:class:`engine.gg18_batch.GG18BatchCoSigners`, and the batch analogue of
+the per-session :class:`.signing.ECDSASigningParty` (reference
+ecdsa_signing_session.go drives one tss-lib party per tx). Each quorum
+member exchanges fixed-shape BYTE BLOCKS (B-row limb serializations) and
+computes every round with the engine's jitted device kernels; the
+scheduler (consumers.batch_scheduler) buckets concurrent requests into
+these batches.
+
+Wire schedule (9 network rounds — the same round structure as GG18,
+reference ecdsa_rounds.go:16-25):
+
+  R1  broadcast  Γ-commitment block + Enc_i(k_i) ciphertext block
+      unicast→j  MtA range proof of Enc_i(k_i) in j's ring
+  R2  unicast→j  MtA responses (γ and w legs): c_b + range proofs
+  R3  broadcast  δ_i block (after verifying responses + CRT decrypting)
+  R4  broadcast  Γ_i decommit + Schnorr PoK of γ_i
+  R5  broadcast  phase-5A (V_i, A_i) commitment block
+  R6  broadcast  5B decommit + Pedersen PoK of (s_i, l_i)
+  R7  broadcast  5C (U_i, T_i) commitment block
+  R8  broadcast  5D decommit
+  R9  broadcast  partial-signature block s_i
+  finalize       combine, low-s normalize, batched ECDSA verify → ok mask
+
+Per-lane semantics: proof/commitment failures mark only their wallet's
+lane false (the result carries a per-session ok mask); structural
+violations (bad block sizes, equivocation) abort the batch with the
+culprit attributed, like the per-session protocol.
+
+All wallets in a batch must share (participants, threshold, epoch) AND
+the quorum's Paillier/ring-Pedersen material (see
+:func:`quorum_material_digest` — the scheduler buckets on it): the engine
+builds one modulus context per party.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import wire
+from ...core import bignum as bn
+from ...core import hostmath as hm
+from ...core import secp256k1_jax as sp
+from ...core.bignum import P256
+from ...core.paillier import PaillierPrivateKey, PreParams
+from ...engine import gg18_batch as gb
+from ...ops.paillier_mxu import RAND_BITS
+from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
+
+Q = hm.SECP_N
+
+R1B = "gg18/b/1/commit"
+R1A = "gg18/b/1/rangeproof"
+R2 = "gg18/b/2/respond"
+R3 = "gg18/b/3/delta"
+R4 = "gg18/b/4/decommit"
+R5 = "gg18/b/5/va-commit"
+R6 = "gg18/b/6/va-reveal"
+R7 = "gg18/b/7/ut-commit"
+R8 = "gg18/b/8/ut-reveal"
+R9 = "gg18/b/9/partial"
+
+
+def quorum_material_digest(share: KeygenShare) -> str:
+    """Digest of the committee's shared Paillier/ring-Pedersen material.
+    Equal across the quorum's nodes for wallets created by the same
+    committee generation — the scheduler's batch-homogeneity key (one
+    modulus context set per batch)."""
+    aux = share.aux
+    if not aux or "paillier_sk" not in aux:
+        return ""
+    sk = aux["paillier_sk"]
+    own_n = int(sk["p"]) * int(sk["q"])
+    mat = {
+        "paillier": dict(aux.get("peer_paillier", {})),
+        "ring": {
+            pid: dict(rp)
+            for pid, rp in aux.get("peer_ring_pedersen", {}).items()
+        },
+    }
+    mat["paillier"][share_owner_key(share)] = str(own_n)
+    mat["ring"][share_owner_key(share)] = dict(aux["preparams"])
+    return hashlib.sha256(wire.canonical_json(mat)).hexdigest()
+
+
+def share_owner_key(share: KeygenShare) -> str:
+    """The owning party's ID, recovered from self_x within the sorted
+    participant universe."""
+    xs = party_xs(share.participants)
+    for pid, x in xs.items():
+        if x == share.self_x:
+            return pid
+    raise ProtocolError("share self_x not in participant universe")
+
+
+def _nb(prof: bn.LimbProfile) -> int:
+    return -(-prof.n_limbs * prof.bits // 8)
+
+
+def _ser(x: jnp.ndarray, prof: bn.LimbProfile) -> str:
+    return np.asarray(bn.limbs_to_bytes_le(x, prof, _nb(prof))).tobytes().hex()
+
+
+def _ser_bytes(arr) -> str:
+    return np.asarray(arr).tobytes().hex()
+
+
+class BatchedECDSASigningParty(PartyBase):
+    """One signer's side of a B-session GG18 batch.
+
+    ``shares``: this node's per-wallet key shares (manifest order —
+    identical on every quorum member). ``digests``: the B 32-byte
+    transaction digests. All shares must come from one committee
+    generation (same participants/threshold/epoch/aux material)."""
+
+    def __init__(
+        self,
+        session_id: str,
+        self_id: str,
+        party_ids: Sequence[str],
+        shares: Sequence[KeygenShare],
+        digests: Sequence[bytes],
+        dom: gb.Domains = gb.Domains(),
+        rng=None,
+    ):
+        import secrets as _secrets
+
+        super().__init__(session_id, self_id, party_ids, rng or _secrets)
+        if len(shares) != len(digests) or not shares:
+            raise ValueError("one share per digest required")
+        self.B = len(shares)
+        self.dom = dom
+        first = shares[0]
+        digest0 = quorum_material_digest(first)
+        if not digest0:
+            raise ProtocolError("shares carry no GG18 aux material")
+        universe = list(first.participants)
+        u_xs = party_xs(universe)
+        for s in shares:
+            if s.key_type != "secp256k1":
+                raise ProtocolError("wrong key type for GG18 batch signing")
+            if s.participants != first.participants:
+                raise ProtocolError("mixed keygen universes in one batch")
+            if s.threshold != first.threshold or s.epoch != first.epoch:
+                raise ProtocolError("mixed threshold/epoch in one batch")
+            if s.self_x != u_xs[self_id]:
+                raise ProtocolError("share does not belong to this node")
+            if len(s.vss_commitments) != s.threshold + 1:
+                raise ProtocolError("missing VSS commitments on share")
+            if quorum_material_digest(s) != digest0:
+                raise ProtocolError("mixed Paillier material in one batch")
+        if len(self.party_ids) < first.threshold + 1:
+            raise ProtocolError("not enough participants for threshold")
+        for pid in self.party_ids:
+            if pid not in u_xs:
+                raise ProtocolError("signer not in keygen universe", pid)
+
+        aux = first.aux
+        sk = PaillierPrivateKey.from_json(aux["paillier_sk"])
+        rp = {k: int(v) for k, v in aux["preparams"].items()}
+        own_pre = PreParams(
+            paillier=sk, NTilde=rp["ntilde"], h1=rp["h1"], h2=rp["h2"],
+            alpha=0, beta=0, P=0, Q=0,
+        )
+        self.own = gb.PartyCtx(self_id, own_pre, rng=self.rng)
+        self.peers: Dict[str, gb.PartyCtx] = {}
+        peer_pk = aux.get("peer_paillier", {})
+        peer_rp = aux.get("peer_ring_pedersen", {})
+        for pid in self.others():
+            if pid not in peer_pk or pid not in peer_rp:
+                raise ProtocolError("missing peer Paillier material", pid)
+            prp = {k: int(v) for k, v in peer_rp[pid].items()}
+            self.peers[pid] = gb.PartyCtx.public(
+                pid, int(peer_pk[pid]), prp["ntilde"], prp["h1"], prp["h2"],
+                rng=self.rng,
+            )
+        self._ctx = {self_id: self.own, **self.peers}
+        # ordered-pair MtA contexts: out = self as Alice, in = self as Bob
+        self.mta_out = {
+            j: gb.MtaBatch(self.own, self.peers[j], dom)
+            for j in self.others()
+        }
+        self.mta_in = {
+            j: gb.MtaBatch(self.peers[j], self.own, dom)
+            for j in self.others()
+        }
+
+        # quorum Shamir data (shared across the batch: one universe)
+        quorum_xs = [u_xs[p] for p in self.party_ids]
+        self._lam = {
+            pid: hm.lagrange_coeff(quorum_xs, u_xs[pid], Q)
+            for pid in self.party_ids
+        }
+        self._uxs = u_xs
+        w_ints = [self._lam[self_id] * s.share % Q for s in shares]
+        self._w = jnp.asarray(bn.batch_to_limbs(w_ints, P256))
+
+        # public per-wallet data on device: Y and every member's W_j
+        pub_comp = jnp.asarray(
+            np.stack([
+                np.frombuffer(s.public_key, dtype=np.uint8) for s in shares
+            ])
+        )
+        self.Y, okY = sp.decompress(pub_comp)
+        C_comp = jnp.asarray(
+            np.stack([
+                np.stack([
+                    np.frombuffer(c, dtype=np.uint8)
+                    for c in s.vss_commitments
+                ])
+                for s in shares
+            ]).transpose(1, 0, 2)  # (t+1, B, 33)
+        )
+        self.W_pts: Dict[str, sp.SecpPointJ] = {}
+        self._ok = jnp.asarray(np.asarray(okY))
+        for pid in self.party_ids:
+            lam_bits = jnp.asarray(
+                sp.scalars_to_bits([self._lam[pid]])[0]
+            )
+            W, okW = gb._blk_W_from_vss(C_comp, u_xs[pid], lam_bits)
+            self.W_pts[pid] = W
+            self._ok = self._ok & okW
+
+        self.ring = sp.scalar_ring()
+        digs = np.stack([
+            np.frombuffer(bytes(d), dtype=np.uint8) for d in digests
+        ])
+        if digs.shape[-1] != 32:
+            raise ProtocolError("digests must be 32 bytes")
+        self.m = self.ring.reduce(
+            bn.bytes_to_limbs_le(jnp.asarray(digs[:, ::-1].copy()), P256, 22)
+        )
+        self._stage = 0
+
+    # -- serialization helpers ----------------------------------------------
+
+    def _bind_row(self, pid: str) -> jnp.ndarray:
+        """(B, 32) session+sender binding row for commitments/PoKs (the
+        distributed analogue of signing.py's _bind: a commitment replayed
+        from another session or party mis-verifies here)."""
+        h = hashlib.sha256(f"{self.session_id}:{pid}".encode()).digest()
+        return jnp.broadcast_to(
+            jnp.asarray(np.frombuffer(h, dtype=np.uint8)), (self.B, 32)
+        )
+
+    def _parse_bytes(self, hexstr: str, nbytes: int, pid: str) -> np.ndarray:
+        try:
+            raw = bytes.fromhex(hexstr)
+        except ValueError:
+            raise ProtocolError("non-hex block", pid)
+        if len(raw) != self.B * nbytes:
+            raise ProtocolError(
+                f"bad block size {len(raw)} != {self.B}x{nbytes}", pid
+            )
+        return np.frombuffer(raw, dtype=np.uint8).reshape(self.B, nbytes)
+
+    def _parse_limbs(
+        self, hexstr: str, prof: bn.LimbProfile, pid: str
+    ) -> jnp.ndarray:
+        arr = self._parse_bytes(hexstr, _nb(prof), pid)
+        return bn.bytes_to_limbs_le(jnp.asarray(arr), prof, prof.n_limbs)
+
+    def _ser_scalar(self, x: jnp.ndarray) -> str:
+        return _ser_bytes(sp.pack_be_32(x))
+
+    def _parse_scalar(self, hexstr: str, pid: str) -> jnp.ndarray:
+        arr = self._parse_bytes(hexstr, 32, pid)
+        return self.ring.reduce(
+            bn.bytes_to_limbs_le(jnp.asarray(arr[:, ::-1].copy()), P256, 22)
+        )
+
+    def _parse_point_block(
+        self, hexstr: str, pid: str
+    ) -> Tuple[jnp.ndarray, sp.SecpPointJ]:
+        comp = self._parse_bytes(hexstr, 33, pid)
+        pts, ok = sp.decompress(jnp.asarray(comp))
+        self._ok = self._ok & ok
+        return jnp.asarray(comp), pts
+
+    # -- round 1 ------------------------------------------------------------
+
+    def start(self) -> List[RoundMsg]:
+        B = self.B
+        rb = gb.rand_bits
+        self._k = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
+        self._gamma = gb._scalar_from_wide_bytes(
+            jnp.asarray(rb(B, 320, self.rng))
+        )
+        self._gblind = jnp.asarray(rb(B, 256, self.rng))
+        Gam, Gam_comp, commit = gb._blk_gamma(
+            self._gamma, self._gblind, self._bind_row(self.self_id)
+        )
+        self._Gamma_own = Gam
+        self._Gamma_comp = Gam_comp
+        u_bits = gb.rand_bit_tensor(B, RAND_BITS, self.rng)
+        kp = gb._scalar_to_plain(self.own.pmx, self._k)
+        c_k, _r = self.own.pmx.encrypt(kp, u_bits)
+        self._c_k = c_k
+        self._kp = kp
+        out = [
+            self.broadcast(
+                R1B,
+                {
+                    "gc": _ser_bytes(commit),
+                    "ck": _ser(c_k, self.own.pmx.prof_n2),
+                },
+            )
+        ]
+        self._alice_beta: Dict[Tuple[str, str], jnp.ndarray] = {}
+        for j in self.others():
+            mta = self.mta_out[j]
+            Ra = mta.alice_randoms(B, self.rng)
+            T = mta.alice_init(kp, Ra)
+            e = mta.e_limbs(mta.alice_challenge(c_k, T))
+            P = mta.alice_finish(e, kp, Ra, u_bits)
+            nt_j = self.peers[j].ctx_nt.prof
+            out.append(
+                self.unicast(
+                    j,
+                    R1A,
+                    {
+                        "z": _ser(T["z"], nt_j),
+                        "u": _ser(T["u"], self.own.pmx.prof_n2),
+                        "w": _ser(T["w"], nt_j),
+                        "s": _ser(P["s"], self.own.pmx.prof_n),
+                        "s1": _ser(P["s1"], mta.p_s1),
+                        "s2": _ser(P["s2"], mta.p_s2),
+                    },
+                )
+            )
+        self._stage = 1
+        return out
+
+    # -- driver --------------------------------------------------------------
+
+    def receive(self, msg: RoundMsg) -> List[RoundMsg]:
+        if self.done:
+            return []
+        self._store(msg)
+        others = self.others()
+        out: List[RoundMsg] = []
+        if (
+            self._stage == 1
+            and self._round_full(R1B, others)
+            and self._round_full(R1A, others)
+        ):
+            out.extend(self._respond())
+            self._stage = 2
+        if self._stage == 2 and self._round_full(R2, others):
+            out.append(self._delta())
+            self._stage = 3
+        if self._stage == 3 and self._round_full(R3, others):
+            out.append(self._decommit_gamma())
+            self._stage = 4
+        if self._stage == 4 and self._round_full(R4, others):
+            out.append(self._phase5a())
+            self._stage = 5
+        if self._stage == 5 and self._round_full(R5, others):
+            out.append(self._phase5b())
+            self._stage = 6
+        if self._stage == 6 and self._round_full(R6, others):
+            out.append(self._phase5c())
+            self._stage = 7
+        if self._stage == 7 and self._round_full(R7, others):
+            out.append(self._phase5d())
+            self._stage = 8
+        if self._stage == 8 and self._round_full(R8, others):
+            out.append(self._partial())
+            self._stage = 9
+        if self._stage == 9 and self._round_full(R9, others):
+            self._finalize()
+        return out
+
+    # -- round 2: Bob side ---------------------------------------------------
+
+    def _peer_ck(self, j: str) -> jnp.ndarray:
+        return self._parse_limbs(
+            self._round_payloads(R1B)[j]["ck"], self.peers[j].pmx.prof_n2, j
+        )
+
+    def _respond(self) -> List[RoundMsg]:
+        B = self.B
+        out = []
+        self._peer_c_k: Dict[str, jnp.ndarray] = {}
+        for j in self.others():
+            mta = self.mta_in[j]  # alice = j, bob = self
+            c_a = self._peer_ck(j)
+            self._peer_c_k[j] = c_a
+            p = self._round_payloads(R1A)[j]
+            nt_own = self.own.ctx_nt.prof
+            T = {
+                "z": self._parse_limbs(p["z"], nt_own, j),
+                "u": self._parse_limbs(p["u"], self.peers[j].pmx.prof_n2, j),
+                "w": self._parse_limbs(p["w"], nt_own, j),
+            }
+            P = {
+                "s": self._parse_limbs(p["s"], self.peers[j].pmx.prof_n, j),
+                "s1": self._parse_limbs(p["s1"], mta.p_s1, j),
+                "s2": self._parse_limbs(p["s2"], mta.p_s2, j),
+            }
+            e = mta.e_limbs(mta.alice_challenge(c_a, T))
+            self._ok = self._ok & mta.bob_check_alice(c_a, T, P, e, self.rng)
+            payload = {}
+            for name, secret in (("gamma", self._gamma), ("w", self._w)):
+                Rb = mta.bob_randoms(B, self.rng)
+                b_e = gb._scalar_to_prof(secret, mta.p_e)
+                Tb = mta.bob_respond(c_a, b_e, Rb)
+                extra = ()
+                if name == "w":
+                    alpha_q = gb._mod_q_from_limbs(Rb["alpha"], mta.p_alpha)
+                    _U_pt, U_comp = gb._base_mul_compressed(alpha_q)
+                    X_comp = sp.compress(self.W_pts[self.self_id])
+                    extra = (U_comp, X_comp)
+                    payload["w_U"] = _ser_bytes(U_comp)
+                e_b = mta.e_limbs(mta.bob_challenge(c_a, Tb, extra))
+                Pb = mta.bob_finish(e_b, b_e, Rb)
+                self._alice_beta[(j, name)] = self.ring.negmod(
+                    gb._mod_q_from_limbs(Rb["beta_prime"], mta.p_bp)
+                )
+                nt_j = self.peers[j].ctx_nt.prof
+                n2_j = self.peers[j].pmx.prof_n2
+                payload.update(
+                    {
+                        f"{name}_cb": _ser(Tb["c_b"], n2_j),
+                        f"{name}_z": _ser(Tb["z"], nt_j),
+                        f"{name}_zp": _ser(Tb["z_p"], nt_j),
+                        f"{name}_t": _ser(Tb["t"], nt_j),
+                        f"{name}_v": _ser(Tb["v"], n2_j),
+                        f"{name}_w": _ser(Tb["w"], nt_j),
+                        f"{name}_s": _ser(Pb["s"], self.peers[j].pmx.prof_n),
+                        f"{name}_s1": _ser(Pb["s1"], mta.p_s1),
+                        f"{name}_s2": _ser(Pb["s2"], mta.p_s2),
+                        f"{name}_t1": _ser(Pb["t1"], mta.p_t1),
+                        f"{name}_t2": _ser(Pb["t2"], mta.p_s2),
+                    }
+                )
+            out.append(self.unicast(j, R2, payload))
+        return out
+
+    # -- round 3: Alice verifies + decrypts, broadcasts δ_i ------------------
+
+    def _delta(self) -> RoundMsg:
+        ring = self.ring
+        alpha: Dict[Tuple[str, str], jnp.ndarray] = {}
+        for j in self.others():
+            mta = self.mta_out[j]
+            p = self._round_payloads(R2)[j]
+            nt_own = self.own.ctx_nt.prof
+            n2_own = self.own.pmx.prof_n2
+            for name in ("gamma", "w"):
+                Tb = {
+                    "c_b": self._parse_limbs(p[f"{name}_cb"], n2_own, j),
+                    "z": self._parse_limbs(p[f"{name}_z"], nt_own, j),
+                    "z_p": self._parse_limbs(p[f"{name}_zp"], nt_own, j),
+                    "t": self._parse_limbs(p[f"{name}_t"], nt_own, j),
+                    "v": self._parse_limbs(p[f"{name}_v"], n2_own, j),
+                    "w": self._parse_limbs(p[f"{name}_w"], nt_own, j),
+                }
+                Pb = {
+                    "s": self._parse_limbs(p[f"{name}_s"], self.own.pmx.prof_n, j),
+                    "s1": self._parse_limbs(p[f"{name}_s1"], mta.p_s1, j),
+                    "s2": self._parse_limbs(p[f"{name}_s2"], mta.p_s2, j),
+                    "t1": self._parse_limbs(p[f"{name}_t1"], mta.p_t1, j),
+                    "t2": self._parse_limbs(p[f"{name}_t2"], mta.p_s2, j),
+                }
+                extra = ()
+                if name == "w":
+                    U_comp, U_pt = self._parse_point_block(p["w_U"], j)
+                    X_comp = sp.compress(self.W_pts[j])
+                    extra = (U_comp, X_comp)
+                e_b = mta.e_limbs(mta.bob_challenge(self._c_k, Tb, extra))
+                self._ok = self._ok & mta.alice_check_bob(
+                    self._c_k, Tb, Pb, e_b, self.rng
+                )
+                if name == "w":
+                    self._ok = self._ok & gb._withcheck_curve(
+                        gb._mod_q_from_limbs(Pb["s1"], mta.p_s1),
+                        gb._mod_q_from_limbs(e_b, mta.p_e),
+                        U_pt,
+                        self.W_pts[j],
+                    )
+                alpha[(j, name)] = mta.alice_decrypt_share(Tb["c_b"])
+
+        d = ring.mulmod(self._k, self._gamma)
+        s_ = ring.mulmod(self._k, self._w)
+        for j in self.others():
+            d = ring.addmod(
+                d, ring.addmod(alpha[(j, "gamma")], self._alice_beta[(j, "gamma")])
+            )
+            s_ = ring.addmod(
+                s_, ring.addmod(alpha[(j, "w")], self._alice_beta[(j, "w")])
+            )
+        self._delta_own = d
+        self._sigma_own = s_
+        return self.broadcast(R3, {"d": self._ser_scalar(d)})
+
+    # -- round 4: Γ decommit + Schnorr PoK -----------------------------------
+
+    def _decommit_gamma(self) -> RoundMsg:
+        kpok = gb._scalar_from_wide_bytes(
+            jnp.asarray(gb.rand_bits(self.B, 320, self.rng))
+        )
+        A_comp, s_pok = gb._blk_schnorr_prove(
+            kpok, self._gamma, self._Gamma_comp, self._bind_row(self.self_id)
+        )
+        return self.broadcast(
+            R4,
+            {
+                "G": _ser_bytes(self._Gamma_comp),
+                "blind": _ser_bytes(self._gblind),
+                "A": _ser_bytes(A_comp),
+                "spok": self._ser_scalar(s_pok),
+            },
+        )
+
+    # -- round 5A ------------------------------------------------------------
+
+    def _phase5a(self) -> RoundMsg:
+        ring = self.ring
+        delta = self._delta_own
+        Gamma_sum = self._Gamma_own
+        commits = self._round_payloads(R1B)
+        for j in self.others():
+            p = self._round_payloads(R4)[j]
+            G_comp, G_pt = self._parse_point_block(p["G"], j)
+            blind = jnp.asarray(self._parse_bytes(p["blind"], 32, j))
+            commit = jnp.asarray(self._parse_bytes(commits[j]["gc"], 32, j))
+            self._ok = self._ok & gb._blk_gamma_check(
+                blind, G_comp, self._bind_row(j), commit
+            )
+            A_comp = jnp.asarray(self._parse_bytes(p["A"], 33, j))
+            s_pok = self._parse_scalar(p["spok"], j)
+            self._ok = self._ok & gb._blk_schnorr_verify(
+                A_comp, s_pok, G_pt, G_comp, self._bind_row(j)
+            )
+            delta = ring.addmod(
+                delta, self._parse_scalar(self._round_payloads(R3)[j]["d"], j)
+            )
+            Gamma_sum = gb._blk_point_add(Gamma_sum, G_pt)
+        ok_R, R_pt, r, rec = gb._blk_R(delta, Gamma_sum)
+        self._ok = self._ok & ok_R
+        self._R_pt, self._r, self._rec = R_pt, r, rec
+
+        rb = gb.rand_bits
+        B = self.B
+        self._li = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
+        self._rho = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
+        self._ka = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
+        self._kb = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
+        self._va_blind = jnp.asarray(rb(B, 256, self.rng))
+        si, Vi, Ai, vc, ac, cmt = gb._blk_va(
+            self.m, r, self._k, self._sigma_own, self._li, self._rho,
+            R_pt, self._va_blind, self._bind_row(self.self_id),
+        )
+        self._s_own, self._V_own, self._A_own = si, Vi, Ai
+        self._vc, self._ac = vc, ac
+        return self.broadcast(R5, {"c": _ser_bytes(cmt)})
+
+    # -- round 5B ------------------------------------------------------------
+
+    def _phase5b(self) -> RoundMsg:
+        Apok, sa, sb = gb._blk_pedersen_prove(
+            self._ka, self._kb, self._s_own, self._li, self._R_pt,
+            self._vc, self._ac, self._bind_row(self.self_id),
+        )
+        return self.broadcast(
+            R6,
+            {
+                "vc": _ser_bytes(self._vc),
+                "ac": _ser_bytes(self._ac),
+                "blind": _ser_bytes(self._va_blind),
+                "apok": _ser_bytes(Apok),
+                "sa": self._ser_scalar(sa),
+                "sb": self._ser_scalar(sb),
+            },
+        )
+
+    # -- round 5C ------------------------------------------------------------
+
+    def _phase5c(self) -> RoundMsg:
+        V_sum, A_sum = self._V_own, self._A_own
+        for j in self.others():
+            p = self._round_payloads(R6)[j]
+            vc, V_pt = self._parse_point_block(p["vc"], j)
+            ac, A_pt = self._parse_point_block(p["ac"], j)
+            blind = jnp.asarray(self._parse_bytes(p["blind"], 32, j))
+            commit = jnp.asarray(
+                self._parse_bytes(self._round_payloads(R5)[j]["c"], 32, j)
+            )
+            self._ok = self._ok & gb._blk_va_check(
+                blind, vc, ac, self._bind_row(j), commit
+            )
+            apok = jnp.asarray(self._parse_bytes(p["apok"], 33, j))
+            self._ok = self._ok & gb._blk_pedersen_verify(
+                apok, self._parse_scalar(p["sa"], j),
+                self._parse_scalar(p["sb"], j),
+                V_pt, self._R_pt, vc, ac, self._bind_row(j),
+            )
+            V_sum = gb._blk_point_add(V_sum, V_pt)
+            A_sum = gb._blk_point_add(A_sum, A_pt)
+        V = gb._blk_V(V_sum, self.m, self._r, self.Y)
+        self._A_sum = A_sum
+        self._ut_blind = jnp.asarray(gb.rand_bits(self.B, 256, self.rng))
+        Ui, Ti, uc, tc, cmt = gb._blk_ut(
+            self._rho, self._li, V, A_sum, self._ut_blind,
+            self._bind_row(self.self_id),
+        )
+        self._U_own, self._T_own = Ui, Ti
+        self._uc, self._tc = uc, tc
+        return self.broadcast(R7, {"c": _ser_bytes(cmt)})
+
+    # -- round 5D ------------------------------------------------------------
+
+    def _phase5d(self) -> RoundMsg:
+        return self.broadcast(
+            R8,
+            {
+                "uc": _ser_bytes(self._uc),
+                "tc": _ser_bytes(self._tc),
+                "blind": _ser_bytes(self._ut_blind),
+            },
+        )
+
+    # -- round 5E ------------------------------------------------------------
+
+    def _partial(self) -> RoundMsg:
+        U_s, T_s = self._U_own, self._T_own
+        for j in self.others():
+            p = self._round_payloads(R8)[j]
+            uc, U_pt = self._parse_point_block(p["uc"], j)
+            tc, T_pt = self._parse_point_block(p["tc"], j)
+            blind = jnp.asarray(self._parse_bytes(p["blind"], 32, j))
+            commit = jnp.asarray(
+                self._parse_bytes(self._round_payloads(R7)[j]["c"], 32, j)
+            )
+            self._ok = self._ok & gb._blk_ut_check(
+                blind, uc, tc, self._bind_row(j), commit
+            )
+            U_s = gb._blk_point_add(U_s, U_pt)
+            T_s = gb._blk_point_add(T_s, T_pt)
+        self._ok = self._ok & gb._blk_point_eq(U_s, T_s)
+        return self.broadcast(R9, {"s": self._ser_scalar(self._s_own)})
+
+    def _finalize(self) -> None:
+        s = self._s_own
+        for j in self.others():
+            s = self.ring.addmod(
+                s, self._parse_scalar(self._round_payloads(R9)[j]["s"], j)
+            )
+        ok_f, s, rec = gb._blk_final(s, self.m, self._r, self.Y, self._rec)
+        ok = self._ok & ok_f
+        self.result = {
+            "r": np.asarray(sp.pack_be_32(self._r)),
+            "s": np.asarray(sp.pack_be_32(s)),
+            "recovery": np.asarray(rec),
+            "ok": np.asarray(ok),
+        }
+        self.done = True

@@ -50,9 +50,13 @@ class DirectMessaging(abc.ABC):
     request/reply unicast with bounded retry."""
 
     @abc.abstractmethod
-    def send(self, topic: str, data: bytes) -> None:
+    def send(self, topic: str, data: bytes, timeout_s: Optional[float] = None) -> None:
         """Blocks until the receiver acks; raises TransportError after the
-        retry budget (reference: 3 s timeout × 3 attempts, 50 ms delay)."""
+        retry budget (reference default: 3 s timeout × 3 attempts, 50 ms
+        delay). ``timeout_s`` overrides the TOTAL budget with a single
+        long-wait delivery — the caller's statement that a slow receiver
+        is busy, not gone (batched rounds can compute for minutes), and
+        must not be re-delivered to."""
 
     @abc.abstractmethod
     def listen(self, topic: str, handler: Handler) -> Subscription: ...

@@ -169,7 +169,14 @@ class LoopbackFabric:
 
     def direct_send(self, topic: str, data: bytes, timeout_s: float = 3.0,
                     attempts: int = 3, retry_delay_s: float = 0.05) -> None:
-        for attempt in range(attempts):
+        """Acked unicast. Each attempt posts ONE delivery and waits its
+        full per-attempt budget for the ack — a slow (busy) receiver is
+        waited on, never re-delivered, so a loaded system cannot amplify
+        one message into a queue-flooding stream of duplicates. Re-posts
+        happen only when the delivery ERRORED or no subscriber existed."""
+        deadline = time.monotonic() + timeout_s * attempts
+        deliveries = 0
+        while True:
             done = threading.Event()
             err: List[BaseException] = []
             with self._lock:
@@ -189,11 +196,31 @@ class LoopbackFabric:
                     finally:
                         done.set()
 
+                deliveries += 1
                 self._post(run)
-                if done.wait(timeout_s) and not err:
+                # wait for THIS delivery until the overall deadline
+                if done.wait(max(0.0, deadline - time.monotonic())) and not err:
                     return  # acked
+                if not done.is_set():
+                    # still undelivered at the deadline: give the in-flight
+                    # handler no duplicate sibling — just report
+                    raise TransportError(
+                        f"direct send to {topic!r} not acked after "
+                        f"{deliveries} deliveries"
+                    )
+                if err and deliveries >= max(attempts, 3):
+                    # handler keeps ERRORING: bounded re-delivery, never a
+                    # deadline-long 50 ms re-post storm
+                    raise TransportError(
+                        f"direct send to {topic!r} not acked after "
+                        f"{deliveries} deliveries"
+                    )
+            if time.monotonic() >= deadline:
+                raise TransportError(
+                    f"direct send to {topic!r} not acked after "
+                    f"{deliveries} deliveries"
+                )
             time.sleep(retry_delay_s)
-        raise TransportError(f"direct send to {topic!r} not acked after {attempts} attempts")
 
     # -- durable queues -----------------------------------------------------
 
@@ -281,8 +308,13 @@ class LoopbackFabric:
                 return fabric.subscribe(topic, handler, kind="pubsub")
 
         class _DM(DirectMessaging):
-            def send(self, topic, data):
-                fabric.direct_send(topic, data)
+            def send(self, topic, data, timeout_s=None):
+                if timeout_s is None:
+                    fabric.direct_send(topic, data)
+                else:
+                    fabric.direct_send(
+                        topic, data, timeout_s=timeout_s, attempts=1
+                    )
 
             def listen(self, topic, handler):
                 return fabric.subscribe(topic, handler, kind="direct")

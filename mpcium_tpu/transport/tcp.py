@@ -19,10 +19,16 @@ JetStream WorkQueue retention (message_queue.go:56-63). Pub/sub and direct
 traffic stay ephemeral, as in NATS core.
 
 Auth: ``auth_token`` requires every client's first frame to be
-``{"op": "auth", "token": ...}`` (constant-time compare) — the reference's
-NATS user/password credentials (main.go:346-359, config.prod.yaml.template);
-transport encryption remains deployment-level (TLS terminator / private
-network), as with the reference's dev NATS.
+``{"op": "auth", "token": ...}`` — the reference's NATS user/password
+credentials (main.go:346-359, config.prod.yaml.template). The broker
+stores and compares only the SHA-256 of the token (constant-time), so
+config files can hold ``sha256:<hex>`` instead of the secret.
+
+Encryption: ``encrypt=True`` wraps every connection in the AEAD channel
+of :mod:`.secure` (X25519 ephemerals + token-bound HKDF +
+ChaCha20-Poly1305 with per-direction counter nonces) — the equivalent of
+the reference's production TLS-to-NATS posture, with mutual
+authentication riding the shared token instead of certificates.
 
 Framing: newline-delimited JSON, payloads hex-encoded. This is a dev/ops
 fabric for single-digit node counts (the reference's deployment shape);
@@ -30,7 +36,7 @@ protocol payload sizes are small (keygen/signing round messages).
 """
 from __future__ import annotations
 
-import hmac
+
 import itertools
 import json
 import os
@@ -39,6 +45,8 @@ import threading
 import time
 from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
+
+from cryptography.exceptions import InvalidTag as _InvalidTag
 
 from .api import (
     DeadLetterHandler,
@@ -57,9 +65,30 @@ from .loopback import topic_matches
 from ..utils import log
 
 
-def _send_frame(sock: socket.socket, obj: dict) -> None:
-    data = json.dumps(obj, separators=(",", ":")).encode() + b"\n"
-    sock.sendall(data)
+def _send_frame(sock: socket.socket, obj: dict, cipher=None) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    if cipher is not None:
+        data = cipher.encrypt(data).hex().encode()
+    sock.sendall(data + b"\n")
+
+
+def _recv_line_blocking(sock: socket.socket, timeout_s: float = 10.0) -> bytes:
+    """Read one newline-terminated line (handshake only — before the
+    read loop starts)."""
+    sock.settimeout(timeout_s)
+    buf = b""
+    try:
+        while b"\n" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise TransportError("connection closed during handshake")
+            buf += chunk
+    finally:
+        sock.settimeout(None)
+    line, _rest = buf.split(b"\n", 1)
+    # handshake is strictly one line each way before any other traffic, so
+    # _rest is empty by protocol
+    return line
 
 
 class _Conn:
@@ -74,11 +103,12 @@ class _Conn:
         self.lock = threading.Lock()
         self.alive = True
         self.authed = False
+        self.cipher = None  # set by the broker's handshake when encrypting
 
     def send(self, obj: dict) -> bool:
         try:
             with self.lock:
-                _send_frame(self.sock, obj)
+                _send_frame(self.sock, obj, self.cipher)
             return True
         except OSError:
             self.alive = False
@@ -93,9 +123,24 @@ class BrokerServer:
         queue_config: QueueConfig = QueueConfig(),
         journal_path: Optional[str] = None,
         auth_token: Optional[str] = None,
+        journal_fsync: bool = True,
+        encrypt: bool = False,
     ):
+        from .secure import hash_token
+
         self.queue_config = queue_config
-        self.auth_token = auth_token
+        # stored hashed (sha256:<hex>): comparisons are digest-vs-digest,
+        # and configs may carry the digest instead of the secret
+        self.auth_token = None if auth_token is None else hash_token(auth_token)
+        self.encrypt = encrypt
+        if encrypt and auth_token is None:
+            raise ValueError(
+                "encrypt=True requires an auth token (the AEAD channel's "
+                "mutual authentication is token-bound)"
+            )
+        # fsync acked enqueues (host-crash durability); opt out for tests /
+        # throwaway brokers where the per-enqueue fsync cost matters
+        self._journal_fsync = journal_fsync
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         self._conns: Dict[int, _Conn] = {}
@@ -111,6 +156,7 @@ class BrokerServer:
         # did -> (topic, data, deliveries, cid, mid)
         self._mid = itertools.count(1)
         self._journal = None
+        self._jlock = threading.Lock()
         if journal_path is not None:
             self._replay_journal(journal_path)
             self._journal = open(journal_path, "a", buffering=1)
@@ -159,12 +205,20 @@ class BrokerServer:
                     self._seen_ids[(topic.rsplit(".", 1)[0], key)] = now
         os.replace(tmp, path)
 
-    def _journal_write(self, rec: dict) -> None:
-        if self._journal is not None:
-            with self._lock:
-                self._journal.write(
-                    json.dumps(rec, separators=(",", ":")) + "\n"
-                )
+    def _journal_write(self, rec: dict, durable: bool = False) -> None:
+        # dedicated journal lock: fsync latency must not serialize the
+        # broker's global dispatch lock (pub/sub and direct traffic need no
+        # durability and should never stall behind a disk flush)
+        with self._jlock:
+            # re-check under the lock: close() nulls self._journal while a
+            # racing write could otherwise hit a closed file
+            j = self._journal
+            if j is None:
+                return
+            j.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            if durable and self._journal_fsync:
+                j.flush()
+                os.fsync(j.fileno())
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -180,6 +234,7 @@ class BrokerServer:
                     c.sock.close()
                 except OSError:
                     pass
+        with self._jlock:
             if self._journal is not None:
                 self._journal.close()
                 self._journal = None
@@ -201,7 +256,29 @@ class BrokerServer:
                 name=f"broker-read-{conn.cid}", daemon=True,
             ).start()
 
+    def _handshake(self, conn: _Conn) -> None:
+        """Server side of the AEAD channel establishment (secure.py)."""
+        from .secure import derive_cipher, fresh_keypair
+
+        hello = json.loads(_recv_line_blocking(conn.sock))
+        if hello.get("op") != "ehello":
+            raise TransportError("client did not start AEAD handshake")
+        client_pub = bytes.fromhex(hello["epub"])
+        priv, server_pub = fresh_keypair()
+        _send_frame(conn.sock, {"op": "ehello", "epub": server_pub.hex()})
+        conn.cipher = derive_cipher(
+            priv, client_pub, client_pub, server_pub,
+            self.auth_token, is_server=True,
+        )
+
     def _read_loop(self, conn: _Conn) -> None:
+        if self.encrypt:
+            try:
+                self._handshake(conn)
+            except Exception as e:  # noqa: BLE001
+                log.warn("broker: AEAD handshake failed", error=repr(e))
+                self._drop(conn)
+                return
         buf = b""
         try:
             while not self._closed:
@@ -212,8 +289,12 @@ class BrokerServer:
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
                     if line:
+                        if conn.cipher is not None:
+                            line = conn.cipher.decrypt(
+                                bytes.fromhex(line.decode())
+                            )
                         self._handle(conn, json.loads(line))
-        except (OSError, json.JSONDecodeError):
+        except (OSError, ValueError, _InvalidTag):
             pass
         finally:
             self._drop(conn)
@@ -236,8 +317,10 @@ class BrokerServer:
         op = f.get("op")
         if self.auth_token is not None and not conn.authed:
             # first frame must authenticate (reference NATS credentials,
-            # main.go:346-359); constant-time compare, then drop on failure
-            if op == "auth" and hmac.compare_digest(
+            # main.go:346-359); hashed constant-time compare, drop on failure
+            from .secure import token_matches
+
+            if op == "auth" and token_matches(
                 str(f.get("token", "")), self.auth_token
             ):
                 conn.authed = True
@@ -284,9 +367,14 @@ class BrokerServer:
                         return
                     self._seen_ids[dk] = now
             mid = next(self._mid)
+            # enqueues are acknowledged to publishers — fsync (when enabled)
+            # so an accepted request survives a host crash, not just a
+            # process crash ("done" records may be lost: redelivery of a
+            # completed message is the safe direction for a work queue)
             self._journal_write(
                 {"j": "enq", "mid": mid, "topic": f["topic"],
-                 "data": f["data"], "key": key}
+                 "data": f["data"], "key": key},
+                durable=True,
             )
             self._queue_dispatch(f["topic"], f["data"], 0, mid)
         elif op == "qack":
@@ -412,12 +500,29 @@ class TcpClient:
         port: int,
         workers: int = 16,
         auth_token: Optional[str] = None,
+        encrypt: bool = False,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
         self.sock = socket.create_connection((host, port), timeout=10)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._cipher = None
+        if encrypt:
+            if auth_token is None:
+                raise ValueError("encrypt=True requires auth_token")
+            from .secure import derive_cipher, fresh_keypair, hash_token
+
+            priv, epub = fresh_keypair()
+            _send_frame(self.sock, {"op": "ehello", "epub": epub.hex()})
+            hello = json.loads(_recv_line_blocking(self.sock))
+            if hello.get("op") != "ehello":
+                raise TransportError("broker did not complete AEAD handshake")
+            server_pub = bytes.fromhex(hello["epub"])
+            self._cipher = derive_cipher(
+                priv, server_pub, epub, server_pub,
+                hash_token(auth_token), is_server=False,
+            )
         self._wlock = threading.Lock()
         self._sid = itertools.count(1)
         self._rid = itertools.count(1)
@@ -456,7 +561,7 @@ class TcpClient:
         if self._closed:
             raise TransportError("client closed")
         with self._wlock:
-            _send_frame(self.sock, obj)
+            _send_frame(self.sock, obj, self._cipher)
 
     # -- subscription registry ----------------------------------------------
 
@@ -486,9 +591,13 @@ class TcpClient:
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
                     if line:
+                        if self._cipher is not None:
+                            line = self._cipher.decrypt(
+                                bytes.fromhex(line.decode())
+                            )
                         self._dispatch(json.loads(line))
-        except (OSError, json.JSONDecodeError):
-            pass
+        except (OSError, ValueError, _InvalidTag):
+            pass  # a tampered/desynced AEAD stream is a dead connection
 
     def _dispatch(self, f: dict) -> None:
         op = f.get("op")
@@ -603,10 +712,13 @@ class TcpClient:
 
 
 def tcp_transport(
-    host: str, port: int, auth_token: Optional[str] = None
+    host: str,
+    port: int,
+    auth_token: Optional[str] = None,
+    encrypt: bool = False,
 ) -> Transport:
     """Connect to a broker → a :class:`Transport` bundle."""
-    client = TcpClient(host, port, auth_token=auth_token)
+    client = TcpClient(host, port, auth_token=auth_token, encrypt=encrypt)
 
     class _PS(PubSub):
         def publish(self, topic, data):
@@ -619,8 +731,13 @@ def tcp_transport(
             return client._subscribe("pubsub", topic, handler)
 
     class _DM(DirectMessaging):
-        def send(self, topic, data):
-            client.direct_send(topic, data)
+        def send(self, topic, data, timeout_s=None):
+            if timeout_s is None:
+                client.direct_send(topic, data)
+            else:
+                client.direct_send(
+                    topic, data, timeout_s=timeout_s, attempts=1
+                )
 
         def listen(self, topic, handler: Handler):
             return client._subscribe("direct", topic, handler)

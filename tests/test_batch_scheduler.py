@@ -98,6 +98,25 @@ def test_batched_signing_coalesces(cluster):
         f"expected ≤4 batches per node for {n} concurrent txs, got {per_node}"
     )
 
+    # claim-leak regression (round-3 advisor finding): a batch must finish
+    # the dedup claims of requests it covered — on the manifest leader as
+    # well as on followers. A stranded claim would both leak memory and
+    # make any redelivery of the tx a permanent "duplicate session" no-op.
+    import time as _time
+
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        leaked = {
+            ec.node.node_id: [
+                k for k in ec._sessions if k.startswith("bw")
+            ]
+            for ec in cluster.consumers
+        }
+        if not any(leaked.values()):
+            break
+        _time.sleep(0.5)
+    assert not any(leaked.values()), f"stranded dedup claims: {leaked}"
+
 
 def test_batch_preserves_wrong_key_isolation(cluster):
     """A request for an unknown wallet dead-letters (timeout error event)

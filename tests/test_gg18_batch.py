@@ -56,6 +56,75 @@ def test_batched_gg18_3of5(small_preparams):
         assert hm.ecdsa_verify(pub, digest, r, s)
 
 
+def test_batch_verification_attributes_bad_proof(small_preparams):
+    """Randomized batch verification (BGR small-exponent test) must not
+    hide a cheater: a corrupted proof inside a batch fails the combined
+    check, triggers the strict fallback, and is attributed to exactly its
+    session (identifiable abort).
+
+    B=2 on purpose: it shares every heavy kernel shape with the engine
+    tests above — NEW N²-width shapes in a long pytest process trip the
+    XLA CPU AOT serializer segfault on this host (see conftest note).
+    """
+    import jax.numpy as jnp
+
+    from mpcium_tpu.core import bignum as bn
+    from mpcium_tpu.engine.gg18_batch import (
+        RAND_BITS, MtaBatch, PartyCtx, rand_bit_tensor, _scalar_to_plain,
+    )
+
+    assert gb.BATCH_VERIFY == "rand"  # default fast path under test
+    B = 2
+    ctx_a = PartyCtx("node0", small_preparams["node0"])
+    ctx_b = PartyCtx("node1", small_preparams["node1"])
+    mta = MtaBatch(ctx_a, ctx_b, TEST_DOM)
+
+    ks = [secrets.randbelow(gb.Q) for _ in range(B)]
+    kp = _scalar_to_plain(
+        ctx_a.pmx, jnp.asarray(bn.batch_to_limbs(ks, bn.P256))
+    )
+    u_bits = rand_bit_tensor(B, RAND_BITS)
+    c_a, _r = ctx_a.pmx.encrypt(kp, u_bits)
+    Ra = mta.alice_randoms(B)
+    T = mta.alice_init(kp, Ra)
+    e = mta.e_limbs(mta.alice_challenge(c_a, T))
+    P = mta.alice_finish(e, kp, Ra, u_bits)
+
+    ok = np.asarray(mta.bob_check_alice(c_a, T, P, e))
+    assert ok.all(), "honest batch must verify on the fast path"
+
+    # corrupt session 2's randomizer response s
+    s_np = np.asarray(P["s"]).copy()
+    bad = bn.batch_to_limbs(
+        [secrets.randbelow(ctx_a.N - 2) + 1], ctx_a.pmx.prof_n
+    )
+    s_np[1] = bad[0]
+    P_bad = dict(P)
+    P_bad["s"] = jnp.asarray(s_np)
+    ok = np.asarray(mta.bob_check_alice(c_a, T, P_bad, e))
+    assert list(ok) == [True, False], (
+        f"bad proof not attributed correctly: {list(ok)}"
+    )
+
+    # same property for the Bob-direction proof
+    bs = [secrets.randbelow(gb.Q) for _ in range(B)]
+    b_e = jnp.asarray(bn.batch_to_limbs(bs, mta.p_e))
+    Rb = mta.bob_randoms(B)
+    Tb = mta.bob_respond(c_a, b_e, Rb)
+    e_b = mta.e_limbs(mta.bob_challenge(c_a, Tb))
+    Pb = mta.bob_finish(e_b, b_e, Rb)
+    ok = np.asarray(mta.alice_check_bob(c_a, Tb, Pb, e_b))
+    assert ok.all(), "honest Bob batch must verify on the fast path"
+    s_np = np.asarray(Pb["s"]).copy()
+    s_np[1] = bad[0]
+    Pb_bad = dict(Pb)
+    Pb_bad["s"] = jnp.asarray(s_np)
+    ok = np.asarray(mta.alice_check_bob(c_a, Tb, Pb_bad, e_b))
+    assert list(ok) == [True, False], (
+        f"bad Bob proof not attributed correctly: {list(ok)}"
+    )
+
+
 def test_batched_gg18_end_to_end(small_preparams):
     B = 2
     universe = ["node0", "node1", "node2"]

@@ -131,6 +131,15 @@ def test_edge_values(ctx):
     assert _ints(ctx.addmod(X, X), ctx.prof) == [2 * x % m for x in xs]
 
 
+def test_mul_pair_bf16_guard_rejects_wide_operands():
+    """Operands past the f32 overlap-add exactness bound (min block count
+    > 32 ⇒ > 7168 bits) must be rejected, not silently rounded."""
+    n = (mm._BF16_MAX_BLOCKS + 1) * mm._BLOCK
+    x = jnp.ones((1, n), jnp.int32)
+    with pytest.raises(ValueError, match="exactness"):
+        mm._mul_pair_bf16(x, x)
+
+
 def test_mul_pair_bf16_matches_i32():
     """The opt-in bf16 pairwise strategy (MPCIUM_MULPAIR=bf16) is bit-exact
     vs the int32 blocked einsum, including all-max limbs."""

@@ -93,3 +93,19 @@ def test_equal_batch():
     assert all(eq2)
     eq3 = np.asarray(sj.equal(p, ident))
     assert not any(eq3)
+
+
+def test_decompress_roundtrip_and_rejection():
+    ks = rand_scalars(4)
+    bits = jnp.asarray(sj.scalars_to_bits(ks))
+    pts = jax.jit(sj.base_mul)(bits)
+    comp = jax.jit(sj.compress)(pts)
+    got, ok = jax.jit(sj.decompress)(comp)
+    assert np.asarray(ok).all()
+    assert np.asarray(jax.jit(sj.equal)(got, pts)).all()
+    # corrupt one row: bad tag; another: x with no square root
+    bad = np.asarray(comp).copy()
+    bad[0, 0] = 0x05
+    bad[1, 1:] = 0xFF  # x >= p
+    _, ok = jax.jit(sj.decompress)(jnp.asarray(bad))
+    assert list(np.asarray(ok)) == [False, False, True, True]
