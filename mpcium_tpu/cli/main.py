@@ -43,6 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(or MPCIUM_BROKER_TOKEN)",
     )
     broker.add_argument(
+        "--follow", default="",
+        help="run as a hot standby mirroring the primary at host:port "
+        "(takes over when the primary dies; clients list both endpoints "
+        "in broker_standbys)",
+    )
+    broker.add_argument(
         "--encrypt", action="store_true",
         default=os.environ.get("MPCIUM_BROKER_ENCRYPT", "").lower()
         not in ("", "0", "false", "no"),
@@ -72,7 +78,7 @@ def main(argv=None) -> int:
 
         return run_broker(host=args.host, port=args.port,
                           journal=args.journal, token=args.token,
-                          encrypt=args.encrypt)
+                          encrypt=args.encrypt, follow=args.follow)
     build_parser().print_help()
     return 1
 

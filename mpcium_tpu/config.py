@@ -31,6 +31,7 @@ class AppConfig:
     broker_token: str = ""  # shared auth token (reference NATS credentials)
     broker_encrypt: bool = False  # AEAD channel (reference prod TLS posture)
     broker_journal: str = ""  # queue journal path ("" = in-memory queues)
+    broker_standbys: str = ""  # failover endpoints, "host:port[,host:port]"
     batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
     batch_window_s: float = 0.05
     peers_file: str = "peers.json"

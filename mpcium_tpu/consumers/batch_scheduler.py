@@ -298,6 +298,11 @@ class BatchSigningScheduler:
                 and env.from_id != self.node.node_id
                 and env.round == HELLO_ROUND
                 and not env.payload.get("bye")
+                # same gate as Session._on_raw: only a PEER's authentic
+                # hello earns an answer — otherwise any bus client could
+                # use this responder as a signed-decline amplifier
+                and env.from_id in self.node.peer_ids
+                and self.node.identity.verify_envelope(env)
             ):
                 bye()
 

@@ -77,10 +77,13 @@ def run_node(
         initiator_pubkey=bytes.fromhex(cfg.event_initiator_pubkey),
         passphrase=passphrase,
     )
+    from ..transport.tcp import parse_addrs
+
     transport = tcp_transport(
         cfg.broker_host, cfg.broker_port,
         auth_token=cfg.broker_token or None,
         encrypt=cfg.broker_encrypt,
+        standbys=parse_addrs(cfg.broker_standbys),
     )
     registry = PeerRegistry(name, list(peers), control_kv)
     node = Node(
@@ -131,11 +134,14 @@ def run_broker(
     journal: str = "",
     token: str = "",
     encrypt: bool = False,
+    follow: str = "",
 ):
     """The `nats-server` analogue: `mpcium-tpu broker`. CLI flags win;
-    otherwise config.yaml's broker_journal/broker_token apply."""
+    otherwise config.yaml's broker_journal/broker_token apply. ``follow``
+    ("host:port") starts this broker as a hot standby mirroring that
+    primary's queue state until the primary dies."""
     from ..config import init_config
-    from ..transport.tcp import BrokerServer
+    from ..transport.tcp import BrokerServer, parse_addrs
 
     cfg = init_config()
     broker = BrokerServer(
@@ -143,6 +149,7 @@ def run_broker(
         journal_path=journal or cfg.broker_journal or None,
         auth_token=token or cfg.broker_token or None,
         encrypt=encrypt or cfg.broker_encrypt,
+        follow=parse_addrs(follow)[0] if follow else None,
     )
     log.init()
     log.info("broker listening", host=broker.host, port=broker.port)

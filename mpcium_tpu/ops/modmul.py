@@ -215,18 +215,25 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
         preferred_element_type=jnp.float32,
     )
     bt = bx + by - 1
-    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.float32)
-    lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
-    hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
+    # overlap-add in INT32: the f32 block products hold integers up to
+    # ~5·10⁵, beyond bf16's mantissa — a float matmul here is silently
+    # demoted to one-pass bf16 on the TPU MXU (CPU f32 einsum is exact,
+    # which is why only on-chip runs ever saw wrong products). The 0/1
+    # block-conv contraction is cheap; integer dot_general is exact on
+    # every backend.
+    prods_i = prods.astype(jnp.int32)
+    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.int32)
+    lo = jnp.einsum("...uvn,uvt->...tn", prods_i[..., :_BLOCK], blk)
+    hi = jnp.einsum("...uvn,uvt->...tn", prods_i[..., _BLOCK:], blk)
     hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
     lo_flat = jnp.pad(
         lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
         [(0, 0)] * (lo.ndim - 2) + [(0, _BLOCK)],
-    ).astype(jnp.int32)
+    )
     hi_flat = jnp.pad(
         hi.reshape(hi.shape[:-2] + (bt * _BLOCK,)),
         [(0, 0)] * (hi.ndim - 2) + [(_BLOCK, 0)],
-    ).astype(jnp.int32)
+    )
     total = carry(lo_flat + hi_flat)
     return total[..., : n_x + n_y]
 

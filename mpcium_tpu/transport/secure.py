@@ -84,8 +84,15 @@ def derive_cipher(
 
 def hash_token(token: str) -> str:
     """Canonical stored form of a broker token: sha256:<hex>. Accepts an
-    already-hashed value unchanged (so config files can hold only the
-    digest, never the secret)."""
+    already-hashed value unchanged.
+
+    The digest form is itself a FULL broker credential (it authenticates
+    and keys the AEAD channel — deliberately so, which is how a standby
+    broker configured with only the digest can follow its primary).
+    Holding the digest in config instead of the raw token protects only
+    one thing: a raw token reused across systems is not exposed to
+    whoever reads this config. Treat ``sha256:<hex>`` values with the
+    same care as the secret (SECURITY.md "Broker channel")."""
     if token.startswith("sha256:"):
         return token
     return "sha256:" + hashlib.sha256(token.encode()).hexdigest()

@@ -22,13 +22,31 @@ Auth: ``auth_token`` requires every client's first frame to be
 ``{"op": "auth", "token": ...}`` — the reference's NATS user/password
 credentials (main.go:346-359, config.prod.yaml.template). The broker
 stores and compares only the SHA-256 of the token (constant-time), so
-config files can hold ``sha256:<hex>`` instead of the secret.
+config files can hold ``sha256:<hex>`` instead of the secret (the digest
+is still a full credential for this broker — see SECURITY.md).
 
 Encryption: ``encrypt=True`` wraps every connection in the AEAD channel
 of :mod:`.secure` (X25519 ephemerals + token-bound HKDF +
 ChaCha20-Poly1305 with per-direction counter nonces) — the equivalent of
 the reference's production TLS-to-NATS posture, with mutual
 authentication riding the shared token instead of certificates.
+
+High availability: the reference clusters NATS (and JetStream replicates
+streams); here a second broker started with ``follow=(host, port)`` runs
+as a **hot standby** — it attaches to the primary over the same
+authenticated/encrypted channel, snapshots every not-yet-done queue
+message, then mirrors the live enqueue/done stream into its own journal.
+Clients list both endpoints (``TcpClient(addrs=[primary, standby] )`` /
+config ``broker_standbys``): when the primary dies they transparently
+reconnect down the list, re-authenticate, and replay their
+subscriptions, and the standby serves the mirrored backlog. Semantics
+across a failover are NATS-like: durable queues are at-least-once
+(consumers are idempotent; the dedup window does not replicate for
+snapshot entries), pub/sub and direct traffic are ephemeral (app-level
+acks/retries cover the gap). Split-brain is bounded by the address-list
+ordering — clients prefer the primary while it is reachable — and there
+is no automatic fail-back: re-arming HA after an outage means restarting
+the dead broker as the new standby (runbook in INSTALLATION.md).
 
 Framing: newline-delimited JSON, payloads hex-encoded. This is a dev/ops
 fabric for single-digit node counts (the reference's deployment shape);
@@ -99,6 +117,7 @@ class _Conn:
         self.broker = broker
         self.cid = cid
         self.subs: Dict[int, Tuple[str, str]] = {}  # sid -> (kind, pattern)
+        self.is_replica = False  # a standby broker following this one
         self.wants_dead_letters = False
         self.lock = threading.Lock()
         self.alive = True
@@ -125,6 +144,7 @@ class BrokerServer:
         auth_token: Optional[str] = None,
         journal_fsync: bool = True,
         encrypt: bool = False,
+        follow: Optional[Tuple[str, int]] = None,
     ):
         from .secure import hash_token
 
@@ -152,9 +172,10 @@ class BrokerServer:
         self._dedup_window_s = 120.0
         self._seen_ids: Dict[Tuple[str, str], float] = {}
         self._pending_q: deque = deque()  # (topic, data, deliveries, mid)
+        self._pending_mids: Set[int] = set()  # mirror of _pending_q mids
         self._inflight: Dict[int, Tuple[str, str, int, int, int]] = {}
         # did -> (topic, data, deliveries, cid, mid)
-        self._mid = itertools.count(1)
+        self._mid_next = 1  # next mid (plain int: replication bumps it)
         self._journal = None
         self._jlock = threading.Lock()
         if journal_path is not None:
@@ -165,6 +186,15 @@ class BrokerServer:
             target=self._accept_loop, name="broker-accept", daemon=True
         )
         self._accept_thread.start()
+        # -- standby mode: follow a primary's queue state until it dies ----
+        # (see the "High availability" section of the module docstring)
+        self._follow = follow
+        self._follower_cli: Optional["TcpClient"] = None
+        self._rep_synced = threading.Event()
+        if follow is not None:
+            threading.Thread(
+                target=self._follow_loop, name="broker-follow", daemon=True
+            ).start()
 
     # -- durability ---------------------------------------------------------
 
@@ -192,7 +222,7 @@ class BrokerServer:
                         max_mid = max(max_mid, rec["mid"])
                     elif rec.get("j") == "done":
                         pending.pop(rec["mid"], None)
-        self._mid = itertools.count(max_mid + 1)
+        self._mid_next = max_mid + 1
         tmp = path + ".tmp"
         now = time.monotonic()
         with open(tmp, "w") as fh:
@@ -201,6 +231,7 @@ class BrokerServer:
                     {"j": "enq", "mid": mid, "topic": topic, "data": data,
                      "key": key}, separators=(",", ":")) + "\n")
                 self._pending_q.append((topic, data, 0, mid))
+                self._pending_mids.add(mid)
                 if key:
                     self._seen_ids[(topic.rsplit(".", 1)[0], key)] = now
         os.replace(tmp, path)
@@ -224,12 +255,30 @@ class BrokerServer:
 
     def close(self) -> None:
         self._closed = True
+        if self._follower_cli is not None:
+            self._follower_cli.close()
         try:
             self._srv.close()
         except OSError:
             pass
+        # wake the accept thread: its blocked accept() holds a reference
+        # to the listening socket, which otherwise stays in LISTEN and
+        # squats the port against a broker restart
+        try:
+            socket.create_connection((self.host, self.port),
+                                     timeout=1).close()
+        except OSError:
+            pass
         with self._lock:
             for c in self._conns.values():
+                try:
+                    # shutdown FIRST: close() alone neither wakes the read
+                    # thread blocked in recv (whose in-flight syscall keeps
+                    # the kernel socket alive, squatting the port against a
+                    # restart) nor sends FIN to the peer
+                    c.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     c.sock.close()
                 except OSError:
@@ -247,6 +296,11 @@ class BrokerServer:
                 sock, _ = self._srv.accept()
             except OSError:
                 return
+            if self._closed:
+                try:
+                    sock.close()
+                finally:
+                    return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _Conn(sock, self, next(self._cid))
             with self._lock:
@@ -366,7 +420,9 @@ class BrokerServer:
                     if dk in self._seen_ids:
                         return
                     self._seen_ids[dk] = now
-            mid = next(self._mid)
+            with self._lock:
+                mid = self._mid_next
+                self._mid_next += 1
             # enqueues are acknowledged to publishers — fsync (when enabled)
             # so an accepted request survives a host crash, not just a
             # process crash ("done" records may be lost: redelivery of a
@@ -376,12 +432,17 @@ class BrokerServer:
                  "data": f["data"], "key": key},
                 durable=True,
             )
-            self._queue_dispatch(f["topic"], f["data"], 0, mid)
+            self._queue_dispatch(
+                f["topic"], f["data"], 0, mid,
+                rep_rec={"j": "enq", "mid": mid, "topic": f["topic"],
+                         "data": f["data"], "key": key},
+            )
         elif op == "qack":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
             if v:
                 self._journal_write({"j": "done", "mid": v[4]})
+                self._replicate({"j": "done", "mid": v[4]})
         elif op == "qnak":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
@@ -389,12 +450,124 @@ class BrokerServer:
                 topic, data, deliveries, _cid, mid = v
                 if f.get("permanent"):
                     self._journal_write({"j": "done", "mid": mid})
+                    self._replicate({"j": "done", "mid": mid})
                     return
                 if deliveries >= self.queue_config.max_deliver:
                     self._journal_write({"j": "done", "mid": mid})
+                    self._replicate({"j": "done", "mid": mid})
                     self._dead_letter(topic, data, deliveries)
                 else:
                     self._queue_dispatch(topic, data, deliveries, mid)
+        elif op == "replica":
+            # a standby broker wants the queue state: snapshot every
+            # not-yet-done message (pending + inflight: inflight would be
+            # redelivered after a failover anyway — at-least-once). The
+            # snapshot is SENT while holding the broker lock: a concurrent
+            # qack's live "done" record must not overtake the snapshot
+            # "enq" for the same mid (the standby would keep a completed
+            # message pending forever). Snapshot size is bounded by the
+            # undone backlog; stalling dispatch for its transmission is
+            # the price of a consistent cut.
+            with self._lock:
+                snapshot = [
+                    {"j": "enq", "mid": mid, "topic": t, "data": d}
+                    for (t, d, _dl, mid) in self._pending_q
+                ] + [
+                    {"j": "enq", "mid": v[4], "topic": v[0], "data": v[1]}
+                    for v in self._inflight.values()
+                ]
+                for rec in sorted(snapshot, key=lambda r: r["mid"]):
+                    conn.send({"op": "rep", **rec})
+                conn.send({"op": "rep", "j": "synced"})
+                conn.is_replica = True
+
+    # -- replication (standby brokers) ---------------------------------------
+
+    def _replicate(self, rec: dict) -> None:
+        """Stream a queue-journal record to every attached standby."""
+        with self._lock:
+            reps = [c for c in self._conns.values() if c.is_replica]
+        for c in reps:
+            c.send({"op": "rep", **rec})
+
+    def _follow_loop(self) -> None:
+        """Standby side: attach to the primary, mirror its queue state into
+        our own journal/pending set, and keep mirroring. A lost primary
+        connection is NOT assumed to be primary death (a transient blip
+        must not silently disarm replication): the loop re-attaches and
+        re-snapshots forever — the snapshot/stream dedup in
+        _apply_replica_record makes re-follows idempotent, and "done"s
+        missed during an outage at worst leave already-completed messages
+        pending here (redelivery of completed work is the safe direction;
+        consumers are idempotent). While the primary is actually down this
+        broker simply keeps serving — clients reach it via their address
+        lists — so "promotion" needs no state transition at all."""
+        host, port = self._follow
+        token = self.auth_token  # hashed form authenticates (secure.py)
+        attached = False
+        while not self._closed:
+            try:
+                cli = TcpClient(
+                    host, port, workers=2, auth_token=token,
+                    encrypt=self.encrypt, reconnect=False,
+                )
+            except (OSError, TransportError):
+                if attached:
+                    attached = False
+                    log.warn(
+                        "broker standby: primary unreachable — serving "
+                        "active, will re-follow when it returns",
+                        primary=f"{host}:{port}",
+                    )
+                time.sleep(1.0)
+                continue
+            self._follower_cli = cli
+            cli._rep_handler = self._apply_replica_record
+            try:
+                cli._send({"op": "replica"})
+            except TransportError:
+                cli.close()
+                continue
+            attached = True
+            log.info("broker standby: following primary",
+                     primary=f"{host}:{port}")
+            cli._reader.join()  # blocks until the primary connection dies
+            cli.close()
+            self._follower_cli = None
+
+    def _apply_replica_record(self, rec: dict) -> None:
+        j = rec.get("j")
+        if j == "synced":
+            self._rep_synced.set()
+            return
+        if j == "enq":
+            mid = rec["mid"]
+            topic, data, key = rec["topic"], rec["data"], rec.get("key", "")
+            with self._lock:
+                # local mid counter must stay ahead of replicated ids so
+                # post-promotion enqueues never collide
+                self._mid_next = max(self._mid_next, mid + 1)
+                if mid in self._pending_mids:
+                    return  # snapshot/stream or re-follow overlap
+                if key:
+                    self._seen_ids[(topic.rsplit(".", 1)[0], key)] = (
+                        time.monotonic()
+                    )
+                self._pending_q.append((topic, data, 0, mid))
+                self._pending_mids.add(mid)
+            self._journal_write(
+                {"j": "enq", "mid": mid, "topic": topic, "data": data,
+                 "key": key},
+                durable=True,
+            )
+        elif j == "done":
+            with self._lock:
+                if rec["mid"] in self._pending_mids:
+                    self._pending_mids.discard(rec["mid"])
+                    self._pending_q = deque(
+                        e for e in self._pending_q if e[3] != rec["mid"]
+                    )
+            self._journal_write({"j": "done", "mid": rec["mid"]})
 
     # -- pub/sub -------------------------------------------------------------
 
@@ -441,9 +614,19 @@ class BrokerServer:
     # -- queues --------------------------------------------------------------
 
     def _queue_dispatch(
-        self, topic: str, data_hex: str, deliveries: int, mid: int
+        self, topic: str, data_hex: str, deliveries: int, mid: int,
+        rep_rec: Optional[dict] = None,
     ) -> None:
+        """Route one queue message. ``rep_rec`` (fresh enqueues only) is
+        the replication record; the replica list is read inside the SAME
+        critical section that enters the message into pending/inflight, so
+        a standby's snapshot cut can never fall between them (a message
+        missing from both snapshot and stream would be silently lost on
+        failover despite the publisher's fsynced ack)."""
+        reps: list = []
         with self._lock:
+            if rep_rec is not None:
+                reps = [c for c in self._conns.values() if c.is_replica]
             targets = [
                 (c, sid)
                 for c in self._conns.values()
@@ -452,10 +635,18 @@ class BrokerServer:
             ]
             if not targets:
                 self._pending_q.append((topic, data_hex, deliveries, mid))
-                return
-            c, sid = targets[next(self._rr) % len(targets)]
-            did = next(self._did)
-            self._inflight[did] = (topic, data_hex, deliveries + 1, c.cid, mid)
+                self._pending_mids.add(mid)
+                c = None
+            else:
+                c, sid = targets[next(self._rr) % len(targets)]
+                did = next(self._did)
+                self._inflight[did] = (
+                    topic, data_hex, deliveries + 1, c.cid, mid
+                )
+        for r in reps:
+            r.send({"op": "rep", **rep_rec})
+        if c is None:
+            return
         if not c.send(
             {"op": "qmsg", "sid": sid, "did": did, "data": data_hex, "topic": topic}
         ):
@@ -466,6 +657,7 @@ class BrokerServer:
     def _flush_pending(self) -> None:
         with self._lock:
             pending, self._pending_q = list(self._pending_q), deque()
+            self._pending_mids.clear()
         for topic, data_hex, deliveries, mid in pending:
             self._queue_dispatch(topic, data_hex, deliveries, mid)
 
@@ -492,7 +684,18 @@ class _ClientSub(Subscription):
 
 
 class TcpClient:
-    """One broker connection per process; thread-pool handler execution."""
+    """One broker connection per process; thread-pool handler execution.
+
+    High availability: ``addrs`` lists broker endpoints in preference
+    order (primary first, standbys after — the NATS client's server-list
+    semantics). The initial connect walks the list until one accepts; a
+    lost connection triggers transparent failover in the reader thread —
+    reconnect (cycling the list with backoff up to
+    ``reconnect_deadline_s``), re-authenticate, re-establish the AEAD
+    channel with fresh ephemerals, and replay every live subscription.
+    In-flight direct sends fail fast on disconnect so their app-level
+    retry budgets (point2point semantics) spend the wait productively.
+    """
 
     def __init__(
         self,
@@ -501,32 +704,24 @@ class TcpClient:
         workers: int = 16,
         auth_token: Optional[str] = None,
         encrypt: bool = False,
+        addrs: Optional[List[Tuple[str, int]]] = None,
+        reconnect: bool = True,
+        reconnect_deadline_s: float = 60.0,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
-        self.sock = socket.create_connection((host, port), timeout=10)
-        self.sock.settimeout(None)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._cipher = None
-        if encrypt:
-            if auth_token is None:
-                raise ValueError("encrypt=True requires auth_token")
-            from .secure import derive_cipher, fresh_keypair, hash_token
-
-            priv, epub = fresh_keypair()
-            _send_frame(self.sock, {"op": "ehello", "epub": epub.hex()})
-            hello = json.loads(_recv_line_blocking(self.sock))
-            if hello.get("op") != "ehello":
-                raise TransportError("broker did not complete AEAD handshake")
-            server_pub = bytes.fromhex(hello["epub"])
-            self._cipher = derive_cipher(
-                priv, server_pub, epub, server_pub,
-                hash_token(auth_token), is_server=False,
-            )
+        if encrypt and auth_token is None:
+            raise ValueError("encrypt=True requires auth_token")
+        self._addrs: List[Tuple[str, int]] = list(addrs or []) or [(host, port)]
+        self._auth_token = auth_token
+        self._encrypt = encrypt
+        self._reconnect = reconnect
+        self._reconnect_deadline_s = reconnect_deadline_s
         self._wlock = threading.Lock()
         self._sid = itertools.count(1)
         self._rid = itertools.count(1)
-        self._handlers: Dict[int, Tuple[str, object]] = {}
+        # sid -> (kind, pattern, handler); pattern kept for failover replay
+        self._handlers: Dict[int, Tuple[str, str, object]] = {}
         self._dack_events: Dict[int, Tuple[threading.Event, List[bool]]] = {}
         self._dead_handlers: List[DeadLetterHandler] = []
         self._pool = ThreadPoolExecutor(max_workers=workers,
@@ -536,20 +731,92 @@ class TcpClient:
         self._qpool = ThreadPoolExecutor(max_workers=workers,
                                          thread_name_prefix="tcpbus-q")
         self._closed = False
-        self._auth_evt = threading.Event()
-        self._auth_ok = False
+        self._connected = threading.Event()
+        # replication hook: a standby BrokerServer following a primary sets
+        # this to receive "rep" frames (see BrokerServer._follow_loop)
+        self._rep_handler = None
+        self.sock, self._cipher = self._establish_any(
+            time.monotonic() + 10, initial=True
+        )
+        self._connected.set()
         self._reader = threading.Thread(
             target=self._read_loop, name="tcpbus-read", daemon=True
         )
         self._reader.start()
-        if auth_token is not None:
-            self._send({"op": "auth", "token": auth_token})
-            if not self._auth_evt.wait(10) or not self._auth_ok:
-                self.close()
-                raise TransportError("broker rejected credentials")
+
+    # -- connection establishment -------------------------------------------
+
+    def _establish(self, addr: Tuple[str, int]):
+        """Open one broker connection: TCP + optional AEAD handshake +
+        auth, all synchronously (no reader thread involved — this runs
+        both at construction and from the reader during failover)."""
+        sock = socket.create_connection(addr, timeout=10)
+        if sock.getsockname() == sock.getpeername():
+            # TCP simultaneous-open on loopback: hammering a dead broker's
+            # (ephemeral) port can self-connect, which both looks like a
+            # broker and SQUATS the port so the real one can't rebind
+            sock.close()
+            raise TransportError(f"self-connection to {addr}")
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        cipher = None
+        try:
+            if self._encrypt:
+                from .secure import derive_cipher, fresh_keypair, hash_token
+
+                priv, epub = fresh_keypair()
+                _send_frame(sock, {"op": "ehello", "epub": epub.hex()})
+                hello = json.loads(_recv_line_blocking(sock))
+                if hello.get("op") != "ehello":
+                    raise TransportError(
+                        "broker did not complete AEAD handshake"
+                    )
+                server_pub = bytes.fromhex(hello["epub"])
+                cipher = derive_cipher(
+                    priv, server_pub, epub, server_pub,
+                    hash_token(self._auth_token), is_server=False,
+                )
+            if self._auth_token is not None:
+                _send_frame(sock, {"op": "auth", "token": self._auth_token},
+                            cipher)
+                line = _recv_line_blocking(sock)
+                if cipher is not None:
+                    line = cipher.decrypt(bytes.fromhex(line.decode()))
+                if json.loads(line).get("op") != "auth_ok":
+                    raise TransportError("broker rejected credentials")
+        except BaseException:
+            sock.close()
+            raise
+        return sock, cipher
+
+    def _establish_any(self, deadline: float, initial: bool = False):
+        """Walk the address list (with backoff) until a broker accepts."""
+        backoff = 0.1
+        last: Exception = TransportError("no broker address configured")
+        while True:
+            for addr in self._addrs:
+                if self._closed:
+                    raise TransportError("client closed")
+                try:
+                    return self._establish(addr)
+                except (OSError, TransportError, ValueError,
+                        _InvalidTag) as e:
+                    last = e
+            if time.monotonic() >= deadline or (initial and not
+                                                self._reconnect):
+                raise TransportError(
+                    f"no broker reachable among {self._addrs}: {last!r}"
+                )
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 2.0)
 
     def close(self) -> None:
         self._closed = True
+        self._connected.set()  # release senders parked on the event
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)  # wake the reader's recv
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -558,16 +825,30 @@ class TcpClient:
         self._qpool.shutdown(wait=False, cancel_futures=True)
 
     def _send(self, obj: dict) -> None:
-        if self._closed:
-            raise TransportError("client closed")
-        with self._wlock:
-            _send_frame(self.sock, obj, self._cipher)
+        # two attempts: a send can lose the connection-lost race with the
+        # reader (event still set, socket just died) — park through the
+        # failover once and retry before surfacing an error
+        for attempt in (0, 1):
+            if self._closed:
+                raise TransportError("client closed")
+            # park briefly through a failover window instead of erroring
+            if not self._connected.wait(timeout=10) or self._closed:
+                raise TransportError("broker unreachable")
+            with self._wlock:
+                try:
+                    _send_frame(self.sock, obj, self._cipher)
+                    return
+                except OSError as e:
+                    err = e
+            if attempt == 0:
+                time.sleep(0.05)  # let the reader notice and clear the event
+        raise TransportError(f"broker connection lost: {err!r}")
 
     # -- subscription registry ----------------------------------------------
 
     def _subscribe(self, kind: str, pattern: str, handler) -> _ClientSub:
         sid = next(self._sid)
-        self._handlers[sid] = (kind, handler)
+        self._handlers[sid] = (kind, pattern, handler)
         self._send({"op": "sub", "kind": kind, "pattern": pattern, "sid": sid})
         return _ClientSub(self, sid)
 
@@ -581,38 +862,104 @@ class TcpClient:
     # -- reader --------------------------------------------------------------
 
     def _read_loop(self) -> None:
-        buf = b""
+        while not self._closed:
+            buf = b""
+            try:
+                while not self._closed:
+                    chunk = self.sock.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if line:
+                            if self._cipher is not None:
+                                line = self._cipher.decrypt(
+                                    bytes.fromhex(line.decode())
+                                )
+                            self._dispatch(json.loads(line))
+            except (OSError, ValueError, _InvalidTag):
+                pass  # a tampered/desynced AEAD stream is a dead connection
+            if self._closed or not self._reconnect:
+                return
+            self._connected.clear()  # before touching the socket: senders
+            # must park on the event, not race into a closing fd
+            # close the dead socket NOW: an abandoned half-open fd leaves
+            # the broker side in FIN_WAIT_2, which (unlike TIME_WAIT)
+            # blocks a restarted broker from rebinding its port
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            # the reader is the only failover driver: it must survive any
+            # surprise (e.g. a racing subscribe during replay) or the
+            # client is bricked with the broker healthy
+            while not self._closed and not self._connected.is_set():
+                try:
+                    self._failover()
+                except Exception as e:  # noqa: BLE001
+                    log.error("tcp bus: failover error; retrying",
+                              error=repr(e))
+                    time.sleep(0.5)
+
+    def _failover(self) -> None:
+        """Reconnect (possibly to a standby) and replay subscriptions."""
+        self._connected.clear()
+        # outstanding direct sends cannot be acked on a dead connection:
+        # fail them now so their retry budgets cover the reconnect window
+        for evt, result in list(self._dack_events.values()):
+            result.append(False)
+            evt.set()
+        log.warn("tcp bus: broker connection lost; failing over",
+                 addrs=str(self._addrs))
+        # retry FOREVER (the NATS client model): a broker outage longer
+        # than the deadline must degrade to parked/erroring sends, never
+        # permanently brick the process — the deadline only paces how
+        # often the outage is logged
+        while True:
+            try:
+                sock, cipher = self._establish_any(
+                    time.monotonic() + self._reconnect_deadline_s
+                )
+                break
+            except TransportError as e:
+                if self._closed:
+                    return
+                log.error("tcp bus: no broker reachable; still retrying",
+                          error=repr(e))
+        with self._wlock:
+            self.sock, self._cipher = sock, cipher
+        # replay the live registry on the new broker. list() snapshots the
+        # dict in one C call — a concurrent subscribe/unsubscribe must not
+        # blow up the iteration (late additions park in _send on
+        # _connected and register themselves after the event sets)
         try:
-            while not self._closed:
-                chunk = self.sock.recv(65536)
-                if not chunk:
-                    break
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if line:
-                        if self._cipher is not None:
-                            line = self._cipher.decrypt(
-                                bytes.fromhex(line.decode())
-                            )
-                        self._dispatch(json.loads(line))
-        except (OSError, ValueError, _InvalidTag):
-            pass  # a tampered/desynced AEAD stream is a dead connection
+            for sid, (kind, pattern, _h) in sorted(list(self._handlers.items())):
+                with self._wlock:
+                    _send_frame(self.sock,
+                                {"op": "sub", "kind": kind,
+                                 "pattern": pattern, "sid": sid},
+                                self._cipher)
+            if self._dead_handlers:
+                with self._wlock:
+                    _send_frame(self.sock, {"op": "dead_sub"}, self._cipher)
+        except OSError:
+            return  # next read-loop pass will fail over again
+        self._connected.set()
+        log.info("tcp bus: reconnected", subs=len(self._handlers))
 
     def _dispatch(self, f: dict) -> None:
         op = f.get("op")
-        if op == "auth_ok":
-            self._auth_ok = True
-            self._auth_evt.set()
-            return
-        if op == "auth_err":
-            self._auth_ok = False
-            self._auth_evt.set()
+        if op in ("auth_ok", "auth_err"):
+            return  # auth is synchronous in _establish; stray frames ignored
+        if op == "rep":
+            if self._rep_handler is not None:
+                self._rep_handler(f)
             return
         if op == "msg":
             ent = self._handlers.get(f["sid"])
             if ent:
-                _kind, handler = ent
+                handler = ent[2]
                 data = bytes.fromhex(f["data"])
                 reply = f.get("reply")
                 if reply:
@@ -627,7 +974,7 @@ class TcpClient:
                 ok = True
                 if ent:
                     try:
-                        ent[1](bytes.fromhex(f["data"]))
+                        ent[2](bytes.fromhex(f["data"]))
                     except Exception:  # noqa: BLE001
                         ok = False
                 try:
@@ -650,7 +997,7 @@ class TcpClient:
                     self._send({"op": "qnak", "did": f["did"]})
                     return
                 try:
-                    ent[1](bytes.fromhex(f["data"]))
+                    ent[2](bytes.fromhex(f["data"]))
                     self._send({"op": "qack", "did": f["did"]})
                 except Permanent:
                     self._send({"op": "qnak", "did": f["did"], "permanent": True})
@@ -687,19 +1034,31 @@ class TcpClient:
 
     def direct_send(self, topic: str, data: bytes, timeout_s: float = 3.0,
                     attempts: int = 3, retry_delay_s: float = 0.05) -> None:
-        for _ in range(attempts):
+        """Acked unicast with a TIME budget of ``timeout_s * attempts``
+        total. An instant dack-failure (no subscriber registered at the
+        broker — the normal state mid-failover while peers re-replay
+        their subscriptions at different speeds) must not burn a whole
+        attempt: the budget is a deadline, retried on a short delay, the
+        same patience contract the loopback fabric implements."""
+        deadline = time.monotonic() + timeout_s * max(attempts, 1)
+        while True:
             rid = next(self._rid)
             evt: Tuple[threading.Event, List[bool]] = (threading.Event(), [])
             self._dack_events[rid] = evt
             try:
                 self._send({"op": "direct", "topic": topic, "data": data.hex(),
                             "rid": rid})
-                if evt[0].wait(timeout_s) and evt[1] and evt[1][0]:
+                remaining = deadline - time.monotonic()
+                if (evt[0].wait(min(max(remaining, 0.05), timeout_s))
+                        and evt[1] and evt[1][0]):
                     return
+            except TransportError:
+                pass  # reconnect in progress: retry within the budget
             finally:
                 self._dack_events.pop(rid, None)
+            if time.monotonic() + retry_delay_s >= deadline:
+                raise TransportError(f"direct send to {topic!r} not acked")
             time.sleep(retry_delay_s)
-        raise TransportError(f"direct send to {topic!r} not acked")
 
     def enqueue(self, topic: str, data: bytes, idempotency_key: str = "") -> None:
         self._send({"op": "enqueue", "topic": topic, "data": data.hex(),
@@ -711,14 +1070,37 @@ class TcpClient:
         self._dead_handlers.append(handler)
 
 
+def parse_addrs(spec: str) -> List[Tuple[str, int]]:
+    """``"host:port[,host:port...]"`` → address list (config
+    broker_standbys / --follow)."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not port.isdigit():
+            raise ValueError(
+                f"broker address {part!r} must be host:port "
+                f"(broker_standbys / --follow)"
+            )
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
 def tcp_transport(
     host: str,
     port: int,
     auth_token: Optional[str] = None,
     encrypt: bool = False,
+    standbys: Optional[List[Tuple[str, int]]] = None,
 ) -> Transport:
-    """Connect to a broker → a :class:`Transport` bundle."""
-    client = TcpClient(host, port, auth_token=auth_token, encrypt=encrypt)
+    """Connect to a broker → a :class:`Transport` bundle. ``standbys``
+    appends failover endpoints after the primary (client walks the list)."""
+    client = TcpClient(
+        host, port, auth_token=auth_token, encrypt=encrypt,
+        addrs=[(host, port)] + list(standbys or []),
+    )
 
     class _PS(PubSub):
         def publish(self, topic, data):

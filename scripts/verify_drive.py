@@ -12,7 +12,13 @@ if "host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache_tests")
+import os as _os
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+                  ".jax_cache_tests"),
+)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import faulthandler

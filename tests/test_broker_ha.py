@@ -1,0 +1,159 @@
+"""Broker high availability: hot standby + client failover.
+
+The reference gets HA from NATS clustering / JetStream replication; here a
+standby BrokerServer follows the primary's queue state and clients walk an
+address list. These tests kill the primary for real and assert traffic
+resumes — including delivery of a message that only ever reached the
+primary before it died (replication proof)."""
+import threading
+import time
+
+import pytest
+
+from mpcium_tpu.transport.tcp import BrokerServer, TcpClient, parse_addrs
+
+TOKEN = "ha-test-token"
+
+
+def _mk_pair(tmp_path, encrypt=False):
+    token = TOKEN if encrypt else None
+    primary = BrokerServer(
+        port=0, journal_path=str(tmp_path / "primary.jsonl"),
+        journal_fsync=False, auth_token=token, encrypt=encrypt,
+    )
+    standby = BrokerServer(
+        port=0, journal_path=str(tmp_path / "standby.jsonl"),
+        journal_fsync=False, auth_token=token, encrypt=encrypt,
+        follow=(primary.host, primary.port),
+    )
+    assert standby._rep_synced.wait(10), "standby never synced to primary"
+    return primary, standby
+
+
+def _client(primary, standby, encrypt=False, **kw):
+    return TcpClient(
+        primary.host, primary.port,
+        addrs=[(primary.host, primary.port), (standby.host, standby.port)],
+        auth_token=TOKEN if encrypt else None, encrypt=encrypt,
+        reconnect_deadline_s=15.0, **kw,
+    )
+
+
+@pytest.mark.parametrize("encrypt", [False, True])
+def test_failover_to_standby(tmp_path, encrypt):
+    primary, standby = _mk_pair(tmp_path, encrypt=encrypt)
+    producer = _client(primary, standby, encrypt=encrypt)
+
+    # m1 reaches ONLY the primary (no consumer yet), then the primary dies
+    producer.enqueue("jobs.a", b"m1", idempotency_key="m1")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not standby._pending_q:
+        time.sleep(0.05)
+    assert standby._pending_q, "enqueue was not replicated to the standby"
+    primary.close()
+
+    got = []
+    evt = threading.Event()
+
+    def handler(data):
+        got.append(data)
+        evt.set()
+
+    # a consumer arriving AFTER the primary's death connects straight to
+    # the standby and must receive the replicated backlog
+    consumer = _client(primary, standby, encrypt=encrypt)
+    consumer._subscribe("queue", "jobs.*", handler)
+    assert evt.wait(15), "replicated message never delivered by standby"
+    assert got == [b"m1"]
+
+    # the producer's connection died with the primary: its next enqueue
+    # rides the transparent failover path. The very first write can vanish
+    # into the dead socket's buffer (TCP reports the break on the NEXT
+    # write) — publishers re-send under the same idempotency key, exactly
+    # how the SDK's at-least-once contract expects them to
+    evt.clear()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not evt.is_set():
+        try:
+            producer.enqueue("jobs.a", b"m2", idempotency_key="m2")
+        except Exception:
+            pass
+        evt.wait(0.5)
+    assert evt.is_set(), "post-failover enqueue never delivered"
+    assert got[-1] == b"m2"
+
+    producer.close()
+    consumer.close()
+    standby.close()
+
+
+def test_subscriptions_replay_after_failover(tmp_path):
+    """Pub/sub and queue subscriptions made before the failover keep
+    working on the standby (client replays its registry)."""
+    primary, standby = _mk_pair(tmp_path)
+    a = _client(primary, standby)
+    b = _client(primary, standby)
+
+    seen = []
+    evt = threading.Event()
+    a._subscribe("pubsub", "events.*", lambda d: (seen.append(d), evt.set()))
+
+    primary.close()
+    # b notices the dead socket on its next op; a's reader fails over on
+    # its own. Publish until a's replayed subscription catches one.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not evt.is_set():
+        try:
+            b.publish("events.x", b"hello")
+            time.sleep(0.1)
+        except Exception:
+            time.sleep(0.1)
+    assert evt.is_set(), "pub/sub subscription did not survive failover"
+    assert seen[0] == b"hello"
+
+    a.close()
+    b.close()
+    standby.close()
+
+
+def test_restart_same_broker_reconnects(tmp_path):
+    """Single-broker deployments: a client outlives a broker restart on
+    the same endpoint (journal replays, subscriptions replay)."""
+    jp = str(tmp_path / "solo.jsonl")
+    broker = BrokerServer(port=0, journal_path=jp, journal_fsync=False)
+    host, port = broker.host, broker.port
+    cli = TcpClient(host, port, reconnect_deadline_s=15.0)
+
+    got = []
+    evt = threading.Event()
+    cli._subscribe("queue", "work.*", lambda d: (got.append(d), evt.set()))
+    cli.enqueue("work.q", b"before-restart", idempotency_key="k1")
+    assert evt.wait(10)
+
+    broker.close()
+    time.sleep(0.3)
+    broker2 = BrokerServer(host=host, port=port, journal_path=jp,
+                           journal_fsync=False)
+    # the restarted broker may first REdeliver m1 (its qack can race the
+    # shutdown, and redelivering completed work is the journal's safe
+    # direction) — wait for the new message, tolerating the redelivery
+    cli.enqueue("work.q", b"after-restart", idempotency_key="k2")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and b"after-restart" not in got:
+        evt.clear()
+        evt.wait(0.5)
+    assert b"after-restart" in got, (
+        f"client did not recover from a broker restart: {got}"
+    )
+
+    cli.close()
+    broker2.close()
+
+
+def test_parse_addrs():
+    assert parse_addrs("") == []
+    assert parse_addrs("10.0.0.2:4334") == [("10.0.0.2", 4334)]
+    assert parse_addrs("a:1, b:2,") == [("a", 1), ("b", 2)]
+    assert parse_addrs(":9") == [("127.0.0.1", 9)]
+    with pytest.raises(ValueError, match="host:port"):
+        parse_addrs("broker-standby")  # port-less config typo
