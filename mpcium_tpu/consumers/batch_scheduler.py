@@ -196,7 +196,18 @@ class BatchSigningScheduler:
                 if share.epoch != info.epoch:
                     return False  # mid-reshare — per-session path retries
                 dig = quorum_material_digest(share)
-                self._digest_cache[ck] = dig
+                # one live epoch per wallet: evict superseded epochs so a
+                # long-lived node serving many rotations stays bounded.
+                # Under the lock: concurrent submit() callbacks insert
+                # while this iterates (transport handler thread pool)
+                with self._lock:
+                    stale = [
+                        k for k in self._digest_cache
+                        if k[0] == msg.key_type and k[1] == msg.wallet_id
+                    ]
+                    for k in stale:
+                        del self._digest_cache[k]
+                    self._digest_cache[ck] = dig
             if not dig:
                 return False  # no GG18 aux → per-session path
             extra = (dig,)

@@ -176,6 +176,15 @@ import os
 # kept as an escape hatch / differential-test oracle via MPCIUM_MULPAIR).
 MULPAIR_STRATEGY = os.environ.get("MPCIUM_MULPAIR", "bf16")
 
+# lax.scan body unrolling for exponentiation windows: each step is ~5
+# mulmods (4 squarings + 1 table multiply); unrolling amortizes the TPU
+# while-loop per-step overhead (PERFORMANCE.md gap 3) at the price of a
+# proportionally larger compile. Default stays 1: on this 1-core host
+# compile time is the scarcer resource than scan-step overhead (it ate
+# two bench windows already, PERFORMANCE.md); flip via MPCIUM_SCAN_UNROLL
+# once the on-chip microbench (.scratch/chipcheck.py) proves the win.
+SCAN_UNROLL = int(os.environ.get("MPCIUM_SCAN_UNROLL", "1"))
+
 # Largest block count for which the bf16 overlap-add stays f32-exact:
 # each 32-limb block-product column is ≤ 32·127² = 516,128 and the
 # overlap-add at any output block sums ≤ min(bx, by) columns, so
@@ -238,11 +247,52 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return total[..., : n_x + n_y]
 
 
+def _mul_pair_i8(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Blocked-einsum pairwise product with int8 inputs / int32
+    accumulation. 7-bit limbs fit int8 exactly and integer accumulation
+    has no rounding anywhere, so this is exact at every width; on TPU the
+    MXU's native int8 path peaks ~4x the bf16 path (whether XLA maps this
+    batched rank-32 contraction onto it is measured by
+    .scratch/chipcheck.py, which times every strategy on the real chip).
+    """
+    n_x, n_y = x.shape[-1], y.shape[-1]
+    bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
+    xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
+        x.shape[:-1] + (bx, _BLOCK)
+    ).astype(jnp.int8)
+    yb = bn.take_limbs(y, 0, by * _BLOCK).reshape(
+        y.shape[:-1] + (by, _BLOCK)
+    ).astype(jnp.int8)
+    m = jnp.asarray(np.asarray(bn._conv_tensor(_BLOCK, _BLOCK)), jnp.int8)
+    prods = jnp.einsum(
+        "...ui,...vj,ijn->...uvn", xb, yb, m,
+        preferred_element_type=jnp.int32,
+    )
+    bt = bx + by - 1
+    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.int32)
+    lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
+    hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
+    hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
+    lo_flat = jnp.pad(
+        lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (lo.ndim - 2) + [(0, _BLOCK)],
+    )
+    hi_flat = jnp.pad(
+        hi.reshape(hi.shape[:-2] + (bt * _BLOCK,)),
+        [(0, 0)] * (hi.ndim - 2) + [(_BLOCK, 0)],
+    )
+    total = carry(lo_flat + hi_flat)
+    return total[..., : n_x + n_y]
+
+
 def mul_pair(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """Pairwise (batched × batched) product → normalized (n_x+n_y) limbs.
-    Blocked einsum in the 7-bit family; strategy via MPCIUM_MULPAIR."""
+    Blocked einsum in the 7-bit family; strategy via MPCIUM_MULPAIR
+    (bf16 | i8 | i32)."""
     if MULPAIR_STRATEGY == "bf16":
         return _mul_pair_bf16(x, y)
+    if MULPAIR_STRATEGY == "i8":
+        return _mul_pair_i8(x, y)
     prof = bn.LimbProfile(bits=LIMB_BITS, n_limbs=max(x.shape[-1], y.shape[-1]))
     return bn.mul_wide(x, y, prof)
 
@@ -351,7 +401,8 @@ def _k_powmod(x, ebits, T_mu, T_m, comp, occ: int, n: int):
         )[..., 0, :]
         return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
 
-    acc, _ = lax.scan(step, _one_like(x, n), jnp.moveaxis(digits, -1, 0))
+    acc, _ = lax.scan(step, _one_like(x, n), jnp.moveaxis(digits, -1, 0),
+                      unroll=SCAN_UNROLL)
     return acc
 
 
@@ -370,7 +421,7 @@ def _k_powmod_digits(x, digits, T_mu, T_m, comp, occ: int, n: int):
         sel = tbl[..., d, :]
         return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
 
-    acc, _ = lax.scan(step, _one_like(x, n), digits)
+    acc, _ = lax.scan(step, _one_like(x, n), digits, unroll=SCAN_UNROLL)
     return acc
 
 
@@ -392,7 +443,8 @@ def _k_powmod_fb(tbl, ebits, T_mu, T_m, comp, occ: int, n: int):
         return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
 
     acc, _ = lax.scan(
-        step, _one_like(ebits, n), (jnp.moveaxis(digits, -1, 0), tbl)
+        step, _one_like(ebits, n), (jnp.moveaxis(digits, -1, 0), tbl),
+        unroll=SCAN_UNROLL,
     )
     return acc
 

@@ -170,3 +170,42 @@ class KeygenShare:
             epoch=int(d.get("epoch", 0)),
             aux=dict(d.get("aux", {})),
         )
+
+
+class BatchBlockMixin:
+    """Fixed-shape byte-block helpers shared by the batched parties
+    (batch_dkg dealing rounds, ecdsa.batch_signing). Requires
+    ``session_id: str`` and ``B: int`` on the host class.
+
+    ``_bind_row`` is security-relevant: the (B, 32) session+sender row is
+    hashed into every commitment/PoK so a transcript replayed from
+    another session or attributed to another party mis-verifies. One
+    definition, used by every batched protocol, so it cannot drift.
+    """
+
+    session_id: str
+    B: int
+
+    def _bind_row(self, pid: str):
+        import hashlib
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        h = hashlib.sha256(f"{self.session_id}:{pid}".encode()).digest()
+        return jnp.broadcast_to(
+            jnp.asarray(np.frombuffer(h, dtype=np.uint8)), (self.B, 32)
+        )
+
+    def _parse_block(self, hexstr: str, nbytes: int, pid: str):
+        import numpy as np
+
+        try:
+            raw = bytes.fromhex(hexstr)
+        except ValueError:
+            raise ProtocolError("non-hex block", pid)
+        if len(raw) != self.B * nbytes:
+            raise ProtocolError(
+                f"bad block size {len(raw)} != {self.B}x{nbytes}", pid
+            )
+        return np.frombuffer(raw, dtype=np.uint8).reshape(self.B, nbytes)

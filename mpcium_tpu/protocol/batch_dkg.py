@@ -57,7 +57,8 @@ from ..engine.dkg_batch import (
     _blk_vss_check, _curve, _rand_scalars, _subshare_phase, _xj_bits,
 )
 from ..ops.sha256 import sha256 as dev_sha256
-from .base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
+from .base import (BatchBlockMixin, KeygenShare, PartyBase, ProtocolError,
+                   RoundMsg, party_xs)
 from .ecdsa.keygen import MIN_PAILLIER_BITS
 from .ecdsa.zk import DLNProof, PaillierProof
 
@@ -98,30 +99,13 @@ def _blk_commit_check(bind_row, blind, block, commit):
     return jnp.all(got == commit, axis=-1)
 
 
-class _DealingMixin:
-    """Shared block (de)serialization + Feldman machinery."""
+class _DealingMixin(BatchBlockMixin):
+    """Shared block (de)serialization + Feldman machinery (binding row and
+    block parsing come from protocol.base.BatchBlockMixin — one definition
+    shared with the batched signing party)."""
 
     key_type: str
     B: int
-
-    def _bind_row(self, pid: str) -> jnp.ndarray:
-        import hashlib
-
-        h = hashlib.sha256(f"{self.session_id}:{pid}".encode()).digest()
-        return jnp.broadcast_to(
-            jnp.asarray(np.frombuffer(h, dtype=np.uint8)), (self.B, 32)
-        )
-
-    def _parse_block(self, hexstr: str, nbytes: int, pid: str) -> np.ndarray:
-        try:
-            raw = bytes.fromhex(hexstr)
-        except ValueError:
-            raise ProtocolError("non-hex block", pid)
-        if len(raw) != self.B * nbytes:
-            raise ProtocolError(
-                f"bad block size {len(raw)} != {self.B}x{nbytes}", pid
-            )
-        return np.frombuffer(raw, dtype=np.uint8).reshape(self.B, nbytes)
 
     def _ser_scalars(self, x: jnp.ndarray) -> str:
         return np.asarray(

@@ -52,7 +52,8 @@ from ...core.bignum import P256
 from ...core.paillier import PaillierPrivateKey, PreParams
 from ...engine import gg18_batch as gb
 from ...ops.paillier_mxu import RAND_BITS
-from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
+from ..base import (BatchBlockMixin, KeygenShare, PartyBase, ProtocolError,
+                    RoundMsg, party_xs)
 
 Q = hm.SECP_N
 
@@ -112,7 +113,7 @@ def _ser_bytes(arr) -> str:
     return np.asarray(arr).tobytes().hex()
 
 
-class BatchedECDSASigningParty(PartyBase):
+class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
     """One signer's side of a B-session GG18 batch.
 
     ``shares``: this node's per-wallet key shares (manifest order —
@@ -241,25 +242,10 @@ class BatchedECDSASigningParty(PartyBase):
 
     # -- serialization helpers ----------------------------------------------
 
-    def _bind_row(self, pid: str) -> jnp.ndarray:
-        """(B, 32) session+sender binding row for commitments/PoKs (the
-        distributed analogue of signing.py's _bind: a commitment replayed
-        from another session or party mis-verifies here)."""
-        h = hashlib.sha256(f"{self.session_id}:{pid}".encode()).digest()
-        return jnp.broadcast_to(
-            jnp.asarray(np.frombuffer(h, dtype=np.uint8)), (self.B, 32)
-        )
-
-    def _parse_bytes(self, hexstr: str, nbytes: int, pid: str) -> np.ndarray:
-        try:
-            raw = bytes.fromhex(hexstr)
-        except ValueError:
-            raise ProtocolError("non-hex block", pid)
-        if len(raw) != self.B * nbytes:
-            raise ProtocolError(
-                f"bad block size {len(raw)} != {self.B}x{nbytes}", pid
-            )
-        return np.frombuffer(raw, dtype=np.uint8).reshape(self.B, nbytes)
+    # binding row + block parsing come from protocol.base.BatchBlockMixin
+    # (shared with batch_dkg: one definition of the security-relevant
+    # session+sender binding, so the two cannot drift)
+    _parse_bytes = BatchBlockMixin._parse_block
 
     def _parse_limbs(
         self, hexstr: str, prof: bn.LimbProfile, pid: str

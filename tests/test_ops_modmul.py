@@ -140,18 +140,19 @@ def test_mul_pair_bf16_guard_rejects_wide_operands():
         mm._mul_pair_bf16(x, x)
 
 
-def test_mul_pair_bf16_matches_i32():
-    """The opt-in bf16 pairwise strategy (MPCIUM_MULPAIR=bf16) is bit-exact
-    vs the int32 blocked einsum, including all-max limbs."""
+@pytest.mark.parametrize("strat", [mm._mul_pair_bf16, mm._mul_pair_i8])
+def test_mul_pair_strategies_match_i32(strat):
+    """Every MXU pairwise strategy (bf16 and i8) is bit-exact vs the int32
+    blocked einsum, including all-max limbs."""
     rng = np.random.default_rng(7)
     for n in (32, 160, 320):
         prof = bn.LimbProfile(bits=7, n_limbs=n)
         x = rng.integers(0, 128, (4, n)).astype(np.int32)
         y = rng.integers(0, 128, (4, n)).astype(np.int32)
-        got = np.asarray(mm._mul_pair_bf16(jnp.asarray(x), jnp.asarray(y)))
+        got = np.asarray(strat(jnp.asarray(x), jnp.asarray(y)))
         ref = np.asarray(bn.mul_wide(jnp.asarray(x), jnp.asarray(y), prof))
         np.testing.assert_array_equal(got, ref)
         xm = np.full((2, n), 127, np.int32)
-        got = np.asarray(mm._mul_pair_bf16(jnp.asarray(xm), jnp.asarray(xm)))
+        got = np.asarray(strat(jnp.asarray(xm), jnp.asarray(xm)))
         ref = np.asarray(bn.mul_wide(jnp.asarray(xm), jnp.asarray(xm), prof))
         np.testing.assert_array_equal(got, ref)
