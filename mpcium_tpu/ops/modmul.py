@@ -185,6 +185,12 @@ MULPAIR_STRATEGY = os.environ.get("MPCIUM_MULPAIR", "bf16")
 # once the on-chip microbench (.scratch/chipcheck.py) proves the win.
 SCAN_UNROLL = int(os.environ.get("MPCIUM_SCAN_UNROLL", "1"))
 
+# Fixed-base comb window width (bits). Combs have no squarings, so the
+# mulmod count scales 1/w while table size scales 2^w/w; 8 halves the
+# wide-exponent ring-Pedersen legs vs 4. Per-element-base powmods keep
+# 4-bit windows (squarings dominate there; wider windows barely help).
+COMB_W = int(os.environ.get("MPCIUM_COMB_W", "8"))
+
 # Largest block count for which the bf16 overlap-add stays f32-exact:
 # each 32-limb block-product column is ≤ 32·127² = 516,128 and the
 # overlap-add at any output block sums ≤ min(bx, by) columns, so
@@ -192,14 +198,40 @@ SCAN_UNROLL = int(os.environ.get("MPCIUM_SCAN_UNROLL", "1"))
 _BF16_MAX_BLOCKS = 32
 
 
-def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """Blocked-einsum pairwise product with bf16 inputs / f32 accumulation.
+@functools.lru_cache(maxsize=None)
+def _band_index_mask(n_cols: int):
+    """Gather indices + mask building the Toeplitz band of a 32-limb block:
+    band[i, n] = block[n - i] for 0 <= n-i < _BLOCK else 0. Cached as
+    NUMPY (device conversion happens per trace: jnp.asarray under a jit
+    trace yields a tracer, and caching tracers across traces leaks)."""
+    i = np.arange(_BLOCK)[:, None]
+    nn = np.arange(n_cols)[None, :]
+    d = nn - i
+    ok = (d >= 0) & (d < _BLOCK)
+    return (
+        np.clip(d, 0, _BLOCK - 1).astype(np.int32),
+        ok.astype(np.float32),
+    )
 
-    Exactness: normalized 7-bit limbs (≤127) are exact bf16 values; a
-    32-limb block-product column is ≤ 32·127² < 2²⁴ (f32-exact), and the
-    overlap-add sums ≤ min(bx, by) ≤ 32 such columns < 2²⁴. Requires
-    NORMALIZED inputs (the i32 path tolerates mildly redundant limbs;
-    this one does not).
+
+def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Band-matrix pairwise product: bf16 dot_general on the MXU with f32
+    accumulation, overlap-add as an exact HIGHEST-precision f32 matmul.
+
+    Stage 1 builds the Toeplitz band of each 32-limb block of y
+    (band[v, i, n] = y_v[n-i]) and contracts the limb index on the MXU:
+    prods[..., u, v, n] = Σ_i x_u[i]·y_v[n-i] — a clean batched GEMM
+    instead of the 3-operand einsum (whose outer-product materialization
+    was ~25× slower than equivalent-MAC matmuls on the chip).
+
+    Exactness: normalized 7-bit limbs (≤127) are exact bf16 values;
+    products ≤ 127² accumulate over ≤ 32 terms < 2²² in f32 (the MXU's
+    native accumulator) — exact. The overlap-add sums ≤ min(bx, by) ≤ 32
+    block columns < 2²⁴; it runs as an f32×f32 matmul at
+    Precision.HIGHEST, which is f32-faithful on the TPU MXU (DEFAULT
+    precision demotes f32 dots to one bf16 pass and silently rounds —
+    the round-4 on-chip correctness lesson). Requires NORMALIZED inputs
+    (the i32 path tolerates mildly redundant limbs; this one does not).
     """
     n_x, n_y = x.shape[-1], y.shape[-1]
     bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
@@ -218,22 +250,29 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     yb = bn.take_limbs(y, 0, by * _BLOCK).reshape(
         y.shape[:-1] + (by, _BLOCK)
     ).astype(jnp.bfloat16)
-    m = jnp.asarray(np.asarray(bn._conv_tensor(_BLOCK, _BLOCK)), jnp.bfloat16)
+    idx, mask = _band_index_mask(2 * _BLOCK - 1)
+    # band[..., v, i, n] = y_v[n - i] (0 outside the band)
+    band = jnp.take(yb, jnp.asarray(idx), axis=-1) * jnp.asarray(
+        mask, jnp.bfloat16
+    )
     prods = jnp.einsum(
-        "...ui,...vj,ijn->...uvn", xb, yb, m,
+        "...ui,...vin->...uvn", xb, band,
         preferred_element_type=jnp.float32,
     )
     bt = bx + by - 1
-    # overlap-add in INT32: the f32 block products hold integers up to
-    # ~5·10⁵, beyond bf16's mantissa — a float matmul here is silently
-    # demoted to one-pass bf16 on the TPU MXU (CPU f32 einsum is exact,
-    # which is why only on-chip runs ever saw wrong products). The 0/1
-    # block-conv contraction is cheap; integer dot_general is exact on
-    # every backend.
-    prods_i = prods.astype(jnp.int32)
-    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.int32)
-    lo = jnp.einsum("...uvn,uvt->...tn", prods_i[..., :_BLOCK], blk)
-    hi = jnp.einsum("...uvn,uvt->...tn", prods_i[..., _BLOCK:], blk)
+    # overlap-add as an exact f32 matmul (HIGHEST = f32-faithful on MXU);
+    # every partial sum stays < 2²⁴ by the block guard above
+    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.float32)
+    lo = jnp.einsum(
+        "...uvn,uvt->...tn", prods[..., :_BLOCK], blk,
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    hi = jnp.einsum(
+        "...uvn,uvt->...tn", prods[..., _BLOCK:], blk,
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
     hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
     lo_flat = jnp.pad(
         lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
@@ -427,15 +466,19 @@ def _k_powmod_digits(x, digits, T_mu, T_m, comp, occ: int, n: int):
 
 @functools.partial(jax.jit, static_argnames=("occ", "n"))
 def _k_powmod_fb(tbl, ebits, T_mu, T_m, comp, occ: int, n: int):
-    """comb-table fixed-base: tbl (nw, 16, n) operand, one mulmod/window."""
+    """comb-table fixed-base: tbl (nw, 2^w, n) operand, one mulmod per
+    w-bit window (no squarings — fixed-base combs scale 1/w with window
+    width, unlike per-element-base exponentiation whose squarings
+    dominate; the window width is derived from the table shape)."""
     n_bits = ebits.shape[-1]
     nw = tbl.shape[0]
-    if nw * 4 != n_bits:
+    wbits = tbl.shape[1].bit_length() - 1  # 2^w rows per window
+    if nw * wbits != n_bits:
         ebits = jnp.pad(
-            ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * 4 - n_bits)]
+            ebits, [(0, 0)] * (ebits.ndim - 1) + [(0, nw * wbits - n_bits)]
         )
-    w = ebits.reshape(ebits.shape[:-1] + (nw, 4))
-    digits = (w * jnp.asarray([1, 2, 4, 8], jnp.int32)).sum(-1)
+    w = ebits.reshape(ebits.shape[:-1] + (nw, wbits))
+    digits = (w * jnp.asarray([1 << i for i in range(wbits)], jnp.int32)).sum(-1)
 
     def step(acc, sl):
         d, rows = sl
@@ -573,27 +616,35 @@ class MXUBarrett:
 
     def powmod_fixed_base(self, base: int, ebits: jnp.ndarray) -> jnp.ndarray:
         """base^e mod m, python-int base, per-element exponent bits.
-        Host-precomputed comb tables base^(16^i * w): ONE mulmod per 4-bit
-        window (the ring-Pedersen commitment workhorse)."""
+        Host-precomputed comb tables base^(2^(w·i) · d): ONE mulmod per
+        w-bit window, no squarings (the ring-Pedersen commitment
+        workhorse). Window width COMB_W (default 8): halving the mulmod
+        count vs w=4 at the price of 2^w-row tables — ~100 MB per
+        (base, 2048-bit modulus) for a 2400-bit exponent in the int32
+        limb layout (300 windows x 256 rows x 320 limbs x 4 B),
+        device-resident once per process; budget ~200 MB per
+        counterparty NTilde (h1+h2) when sizing HBM."""
         n_bits = ebits.shape[-1]
-        nw = -(-n_bits // 4)
-        key = (base % self.modulus, nw)
+        wbits = COMB_W
+        nw = -(-n_bits // wbits)
+        key = (base % self.modulus, nw, wbits)
         tbl = self._fb_tables.get(key)
         if tbl is None:
-            # incremental build: b_i = base^(16^i) by squaring, row entries
-            # by repeated multiply - O(nw*16) modmuls, not modexps
+            # incremental build: b_i = base^(2^(w·i)) by squaring, row
+            # entries by repeated multiply - O(nw·2^w) modmuls, not modexps
             m = self.modulus
+            rows = 1 << wbits
             vals = []
             b_i = base % m
             for i in range(nw):
                 acc = 1
-                for w in range(16):
+                for w in range(rows):
                     vals.append(acc)
                     acc = acc * b_i % m
-                b_i = pow(b_i, 16, m)
+                b_i = pow(b_i, rows, m)
             tbl = jnp.asarray(
                 ints_to_limbs(vals, self.prof).reshape(
-                    nw, 16, self.prof.n_limbs
+                    nw, rows, self.prof.n_limbs
                 )
             )
             self._fb_tables[key] = tbl
