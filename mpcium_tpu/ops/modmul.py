@@ -191,6 +191,10 @@ SCAN_UNROLL = int(os.environ.get("MPCIUM_SCAN_UNROLL", "1"))
 # 4-bit windows (squarings dominate there; wider windows barely help).
 COMB_W = int(os.environ.get("MPCIUM_COMB_W", "8"))
 
+# Dispatch audit: set to a dict to accumulate mulmod-equivalent counts
+# per (op, modulus-bits); None disables (no overhead on the hot path).
+AUDIT = None
+
 # Largest block count for which the bf16 overlap-add stays f32-exact:
 # each 32-limb block-product column is ≤ 32·127² = 516,128 and the
 # overlap-add at any output block sums ≤ min(bx, by) columns, so
@@ -214,65 +218,79 @@ def _band_index_mask(n_cols: int):
     )
 
 
-def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """Band-matrix pairwise product: bf16 dot_general on the MXU with f32
-    accumulation, overlap-add as an exact HIGHEST-precision f32 matmul.
+def _mul_pair_band(
+    x: jnp.ndarray, y: jnp.ndarray, op_dtype
+) -> jnp.ndarray:
+    """Band-matrix pairwise product on the MXU, shared by the bf16 and
+    int8 strategies (``op_dtype`` picks the operand path).
 
     Stage 1 builds the Toeplitz band of each 32-limb block of y
     (band[v, i, n] = y_v[n-i]) and contracts the limb index on the MXU:
     prods[..., u, v, n] = Σ_i x_u[i]·y_v[n-i] — a clean batched GEMM
-    instead of the 3-operand einsum (whose outer-product materialization
-    was ~25× slower than equivalent-MAC matmuls on the chip).
+    instead of the 3-operand conv einsum (whose outer-product
+    materialization was ~25× slower than equivalent-MAC matmuls on the
+    chip). Accumulation: bf16 operands accumulate in f32 (exact — 7-bit
+    limbs are exact bf16 values and block columns stay ≤ 32·127² < 2²⁴);
+    int8 operands accumulate in int32 (exact at every width).
 
-    Exactness: normalized 7-bit limbs (≤127) are exact bf16 values;
-    products ≤ 127² accumulate over ≤ 32 terms < 2²² in f32 (the MXU's
-    native accumulator) — exact. The overlap-add sums ≤ min(bx, by) ≤ 32
-    block columns < 2²⁴; it runs as an f32×f32 matmul at
-    Precision.HIGHEST, which is f32-faithful on the TPU MXU (DEFAULT
-    precision demotes f32 dots to one bf16 pass and silently rounds —
-    the round-4 on-chip correctness lesson). Requires NORMALIZED inputs
-    (the i32 path tolerates mildly redundant limbs; this one does not).
+    Stage 2 (overlap-add) sums ≤ min(bx, by) block columns; while
+    min(bx, by) ≤ 32 every partial sum stays < 2²⁴ and it runs as an
+    f32×f32 matmul at Precision.HIGHEST, which is f32-faithful on the
+    TPU MXU (DEFAULT precision demotes f32 dots to one bf16 pass and
+    silently rounds — the round-4 on-chip correctness lesson). Past 32
+    blocks the int8 path falls back to an exact int32 contraction; the
+    bf16 path must reject (its stage 1 is already inexact there).
+    Requires NORMALIZED inputs (the i32 strategy tolerates mildly
+    redundant limbs; this one does not).
     """
     n_x, n_y = x.shape[-1], y.shape[-1]
     bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
-    if min(bx, by) > _BF16_MAX_BLOCKS:
+    wide = min(bx, by) > _BF16_MAX_BLOCKS
+    if wide and op_dtype == jnp.bfloat16:
         # a hard error, not an assert: this guards cryptographic
         # correctness and must survive `python -O`
         raise ValueError(
             f"bf16 pairwise product overlap-add would exceed 2^24 "
             f"exactness: min({bx}, {by}) blocks > {_BF16_MAX_BLOCKS} "
             f"(operands up to {_BF16_MAX_BLOCKS * _BLOCK * LIMB_BITS} "
-            f"bits); use MPCIUM_MULPAIR=i32 for wider operands"
+            f"bits); use MPCIUM_MULPAIR=i8 or i32 for wider operands"
         )
+    acc_dtype = jnp.float32 if op_dtype == jnp.bfloat16 else jnp.int32
     xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
         x.shape[:-1] + (bx, _BLOCK)
-    ).astype(jnp.bfloat16)
+    ).astype(op_dtype)
     yb = bn.take_limbs(y, 0, by * _BLOCK).reshape(
         y.shape[:-1] + (by, _BLOCK)
-    ).astype(jnp.bfloat16)
+    ).astype(op_dtype)
     idx, mask = _band_index_mask(2 * _BLOCK - 1)
     # band[..., v, i, n] = y_v[n - i] (0 outside the band)
     band = jnp.take(yb, jnp.asarray(idx), axis=-1) * jnp.asarray(
-        mask, jnp.bfloat16
+        mask, op_dtype
     )
     prods = jnp.einsum(
         "...ui,...vin->...uvn", xb, band,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=acc_dtype,
     )
     bt = bx + by - 1
-    # overlap-add as an exact f32 matmul (HIGHEST = f32-faithful on MXU);
-    # every partial sum stays < 2²⁴ by the block guard above
-    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.float32)
-    lo = jnp.einsum(
-        "...uvn,uvt->...tn", prods[..., :_BLOCK], blk,
-        precision=lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)
-    hi = jnp.einsum(
-        "...uvn,uvt->...tn", prods[..., _BLOCK:], blk,
-        precision=lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)
+    if wide:
+        # exact int32 overlap-add (VPU; only reachable from the i8 path)
+        prods = prods.astype(jnp.int32)
+        blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.int32)
+        lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
+        hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
+    else:
+        prods = prods.astype(jnp.float32)
+        blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.float32)
+        lo = jnp.einsum(
+            "...uvn,uvt->...tn", prods[..., :_BLOCK], blk,
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        hi = jnp.einsum(
+            "...uvn,uvt->...tn", prods[..., _BLOCK:], blk,
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
     hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
     lo_flat = jnp.pad(
         lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
@@ -284,44 +302,19 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     )
     total = carry(lo_flat + hi_flat)
     return total[..., : n_x + n_y]
+
+
+def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return _mul_pair_band(x, y, jnp.bfloat16)
 
 
 def _mul_pair_i8(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """Blocked-einsum pairwise product with int8 inputs / int32
-    accumulation. 7-bit limbs fit int8 exactly and integer accumulation
-    has no rounding anywhere, so this is exact at every width; on TPU the
-    MXU's native int8 path peaks ~4x the bf16 path (whether XLA maps this
-    batched rank-32 contraction onto it is measured by
-    .scratch/chipcheck.py, which times every strategy on the real chip).
-    """
-    n_x, n_y = x.shape[-1], y.shape[-1]
-    bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
-    xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
-        x.shape[:-1] + (bx, _BLOCK)
-    ).astype(jnp.int8)
-    yb = bn.take_limbs(y, 0, by * _BLOCK).reshape(
-        y.shape[:-1] + (by, _BLOCK)
-    ).astype(jnp.int8)
-    m = jnp.asarray(np.asarray(bn._conv_tensor(_BLOCK, _BLOCK)), jnp.int8)
-    prods = jnp.einsum(
-        "...ui,...vj,ijn->...uvn", xb, yb, m,
-        preferred_element_type=jnp.int32,
-    )
-    bt = bx + by - 1
-    blk = jnp.asarray(np.asarray(bn._conv_tensor(bx, by)), jnp.int32)
-    lo = jnp.einsum("...uvn,uvt->...tn", prods[..., :_BLOCK], blk)
-    hi = jnp.einsum("...uvn,uvt->...tn", prods[..., _BLOCK:], blk)
-    hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
-    lo_flat = jnp.pad(
-        lo.reshape(lo.shape[:-2] + (bt * _BLOCK,)),
-        [(0, 0)] * (lo.ndim - 2) + [(0, _BLOCK)],
-    )
-    hi_flat = jnp.pad(
-        hi.reshape(hi.shape[:-2] + (bt * _BLOCK,)),
-        [(0, 0)] * (hi.ndim - 2) + [(_BLOCK, 0)],
-    )
-    total = carry(lo_flat + hi_flat)
-    return total[..., : n_x + n_y]
+    """int8 band strategy: half the band traffic of bf16, int32
+    accumulation exact at every width (no 32-block rejection — wide
+    operands take the int32 overlap-add fallback). Whether XLA maps the
+    batched K=32 contraction onto the int8 MXU path is measured on the
+    real chip by .scratch/chipcheck.py."""
+    return _mul_pair_band(x, y, jnp.int8)
 
 
 def mul_pair(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
@@ -539,6 +532,17 @@ class MXUBarrett:
         self.m_limbs = bn.to_limbs(modulus, self.prof)
         self._fb_tables: Dict = {}
 
+    # -- audit --------------------------------------------------------------
+
+    def _audit(self, op: str, mulmods: float) -> None:
+        """Record mulmod-equivalent dispatch counts into the module-level
+        AUDIT dict (None = disabled, zero overhead). Key: (op, modulus
+        bits). Used by .scratch/audit_counts.py to budget where the
+        per-signature mulmods go without needing the chip."""
+        if AUDIT is not None:
+            k = (op, self.occ * LIMB_BITS)
+            AUDIT[k] = AUDIT.get(k, 0.0) + mulmods
+
     # -- helpers ------------------------------------------------------------
 
     def const(self, value: int, batch_shape=()) -> jnp.ndarray:
@@ -551,11 +555,13 @@ class MXUBarrett:
     # -- core ---------------------------------------------------------------
 
     def reduce(self, x: jnp.ndarray) -> jnp.ndarray:
+        self._audit("reduce", 0.5)
         return _k_reduce(
             x, self._T_mu, self._T_m, self._comp, self.occ, self.prof.n_limbs
         )
 
     def mulmod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        self._audit("mulmod", 1)
         return _k_mulmod(
             a, b, self._T_mu, self._T_m, self._comp, self.occ,
             self.prof.n_limbs,
@@ -575,6 +581,7 @@ class MXUBarrett:
                 value % self.modulus, self.prof.n_limbs, min_limbs=self.occ
             )
             self._fb_tables[key] = T
+        self._audit("mulmod_const", 0.5)
         return _k_mulmod_const(
             a, T, self._T_mu, self._T_m, self._comp, self.occ,
             self.prof.n_limbs,
@@ -599,6 +606,7 @@ class MXUBarrett:
         if exponent == 0:
             return self.one_like(x)
         nw = -(-exponent.bit_length() // 4)
+        self._audit(f"powmod_const_exp/e{4 * nw}", 5 * nw + 14)
         digits = jnp.asarray(
             [(exponent >> (4 * i)) & 15 for i in range(nw)][::-1], jnp.int32
         )
@@ -609,6 +617,10 @@ class MXUBarrett:
 
     def powmod(self, x: jnp.ndarray, ebits: jnp.ndarray) -> jnp.ndarray:
         """x^e with per-element exponent bits (LSB-first), 4-bit windows."""
+        self._audit(
+            f"powmod/e{ebits.shape[-1]}",
+            5 * (-(-ebits.shape[-1] // 4)) + 14,
+        )
         return _k_powmod(
             x, ebits, self._T_mu, self._T_m, self._comp, self.occ,
             self.prof.n_limbs,
@@ -627,6 +639,7 @@ class MXUBarrett:
         n_bits = ebits.shape[-1]
         wbits = COMB_W
         nw = -(-n_bits // wbits)
+        self._audit(f"powmod_fixed_base/e{n_bits}", nw)
         key = (base % self.modulus, nw, wbits)
         tbl = self._fb_tables.get(key)
         if tbl is None:
@@ -661,6 +674,7 @@ class MXUBarrett:
     def prod_over_batch(self, x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
         """Product of x_b mod m along ``axis`` by log-depth pairwise folds."""
         x = jnp.moveaxis(x, axis, 0)
+        # (no _audit here: the fold's mulmod calls audit themselves)
         while x.shape[0] > 1:
             k = x.shape[0]
             if k % 2:

@@ -156,3 +156,71 @@ def test_mul_pair_strategies_match_i32(strat):
         got = np.asarray(strat(jnp.asarray(xm), jnp.asarray(xm)))
         ref = np.asarray(bn.mul_wide(jnp.asarray(xm), jnp.asarray(xm), prof))
         np.testing.assert_array_equal(got, ref)
+
+
+def test_comb_window_widths_and_edges(ctx):
+    """w-bit comb fixed-base exponentiation: exponent bit-lengths that are
+    not multiples of the window width, plus 0 and all-ones exponents
+    (regression for the COMB_W=8 generalization of the 4-bit comb)."""
+    m = ctx.modulus
+    base = secrets.randbits(ctx.modulus.bit_length() - 4) % m
+    for ebitlen in (5, 8, 12, 63):
+        es = [0, (1 << ebitlen) - 1] + [
+            secrets.randbits(ebitlen) for _ in range(4)
+        ]
+        ebits = jnp.asarray(
+            np.array(
+                [[(e >> i) & 1 for i in range(ebitlen)] for e in es],
+                np.int32,
+            )
+        )
+        got = _ints(ctx.powmod_fixed_base(base, ebits), ctx.prof)
+        assert got == [pow(base, e, m) for e in es], f"comb {ebitlen}"
+
+
+@pytest.mark.parametrize("strat", [mm._mul_pair_bf16, mm._mul_pair_i8])
+def test_mul_pair_band_odd_widths(strat):
+    """Band strategies at limb counts straddling block boundaries
+    (n % 32 in {1, 31, 0} — profiles built directly, since mm.profile
+    block-pads) with 0/1/max edge operands."""
+    for n_limbs in (31, 33, 64):
+        prof = bn.LimbProfile(bits=7, n_limbs=n_limbs)
+        bits = 7 * n_limbs
+        xs = [0, 1, (1 << bits) - 1] + [
+            secrets.randbits(bits) for _ in range(5)
+        ]
+        ys = [(1 << bits) - 1, (1 << bits) - 1, (1 << bits) - 1] + [
+            secrets.randbits(bits) for _ in range(5)
+        ]
+        P = np.asarray(
+            mm.carry(
+                strat(
+                    jnp.asarray(mm.ints_to_limbs(xs, prof)),
+                    jnp.asarray(mm.ints_to_limbs(ys, prof)),
+                )
+            )
+        )
+        got = bn.batch_from_limbs(
+            P, bn.LimbProfile(bits=7, n_limbs=P.shape[-1])
+        )
+        assert got == [x * y for x, y in zip(xs, ys)], f"mul_pair {n_limbs}"
+
+
+def test_mul_pair_i8_wide_fallback():
+    """Operands past the 32-block f32 overlap-add bound (where bf16 must
+    reject) stay exact on the i8 strategy via its int32 fallback."""
+    n_limbs = 33 * mm._BLOCK  # 1056 limbs = 7392 bits > the bf16 bound
+    prof = bn.LimbProfile(bits=7, n_limbs=n_limbs)
+    bits = 7 * n_limbs
+    xs = [(1 << bits) - 1, secrets.randbits(bits)]
+    ys = [(1 << bits) - 1, secrets.randbits(bits)]
+    P = np.asarray(
+        mm.carry(
+            mm._mul_pair_i8(
+                jnp.asarray(mm.ints_to_limbs(xs, prof)),
+                jnp.asarray(mm.ints_to_limbs(ys, prof)),
+            )
+        )
+    )
+    got = bn.batch_from_limbs(P, bn.LimbProfile(bits=7, n_limbs=P.shape[-1]))
+    assert got == [x * y for x, y in zip(xs, ys)]
