@@ -177,7 +177,11 @@ def _secondary_metrics(B: int) -> dict:
     )
 
     dkg = BatchedDKG(ids, threshold=1, key_type="secp256k1", rng=sec)
-    dkg.run(min(B, 64))  # warmup/compile at a smaller shape
+    # warmup at the SAME batch shape: XLA kernels are shape-specialized,
+    # so a smaller warmup left the timed run paying full recompiles
+    # (r4 on-chip: 4.3 wallets/s reported where compute alone is far
+    # higher)
+    dkg.run(B)
     t0 = time.perf_counter()
     dshares = dkg.run(B)
     out["secp256k1_dkg_wallets_per_sec"] = round(
@@ -190,6 +194,7 @@ def _secondary_metrics(B: int) -> dict:
         ["node0", "node1", "node2", "node3", "node4"], new_threshold=2,
         rng=sec,
     )
+    rs.run()  # warmup/compile at the timed shape
     t0 = time.perf_counter()
     rs.run()
     out["reshare_2of3_to_3of5_wallets_per_sec"] = round(

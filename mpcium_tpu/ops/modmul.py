@@ -82,12 +82,16 @@ def _roll_pass(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Exact normalization of non-negative redundant limbs (each < 2^24,
-    total value must fit the limb count — same contract as bignum.carry
-    minus negative-limb support).
+    """Exact normalization of non-negative redundant limbs (total value
+    must fit the limb count; same contract as bignum.carry minus
+    negative-limb support).
 
-    Three roll passes bound limbs by 127 + 8; one generate/propagate
-    carry-lookahead (associative scan, O(log n) depth) finishes.
+    Exactness bound: each roll pass maps a limb bound M to 127 + M/128,
+    so after three passes limbs are ≤ 127 + M/2²¹ + ~1; the lookahead
+    stage needs limbs ≤ 255 (carries 0/1), giving the input contract
+    **limb < 127·2²¹ ≈ 2^27.99**. Callers on the narrow paths stay below
+    2²⁴; the i8 wide fallback approaches the true bound and is guarded
+    at its call site.
     """
     x = _roll_pass(_roll_pass(_roll_pass(x)))
     # now 0 <= limb <= 135: incoming carries are 0/1
@@ -201,6 +205,12 @@ AUDIT = None
 # min(bx, by) ≤ 32 keeps every partial sum ≤ 16,516,096 < 2²⁴.
 _BF16_MAX_BLOCKS = 32
 
+# The i8 strategy's int32 overlap-add is exact at any width, but the
+# final carry() bounds it: lo+hi limbs reach 2*min(bx,by)*32*127^2,
+# which must stay below carry()'s 127*2^21 limit => min(bx,by) <= 258;
+# 256 keeps a margin (operands up to ~57k bits).
+_I8_MAX_BLOCKS = 256
+
 
 @functools.lru_cache(maxsize=None)
 def _band_index_mask(n_cols: int):
@@ -238,22 +248,34 @@ def _mul_pair_band(
     f32×f32 matmul at Precision.HIGHEST, which is f32-faithful on the
     TPU MXU (DEFAULT precision demotes f32 dots to one bf16 pass and
     silently rounds — the round-4 on-chip correctness lesson). Past 32
-    blocks the int8 path falls back to an exact int32 contraction; the
-    bf16 path must reject (its stage 1 is already inexact there).
+    blocks the int8 path falls back to an exact int32 contraction
+    (stage 1 stays exact for BOTH dtypes at any width — the K=32 band
+    contraction's sums never exceed 32·127²; only the f32 overlap-add
+    breaks — but giving bf16 the int32 fallback too would silently
+    change its cost profile, so it rejects instead). The fallback's own
+    ceiling is the final carry: lo+hi limbs reach 2·min(bx,by)·32·127²,
+    which must stay under carry()'s 127·2²¹ bound ⇒ min(bx, by) ≤ 256
+    (operands ≤ ~57k bits), guarded below.
     Requires NORMALIZED inputs (the i32 strategy tolerates mildly
     redundant limbs; this one does not).
     """
     n_x, n_y = x.shape[-1], y.shape[-1]
     bx, by = -(-n_x // _BLOCK), -(-n_y // _BLOCK)
     wide = min(bx, by) > _BF16_MAX_BLOCKS
+    # hard errors, not asserts: these guard cryptographic correctness
+    # and must survive `python -O`
     if wide and op_dtype == jnp.bfloat16:
-        # a hard error, not an assert: this guards cryptographic
-        # correctness and must survive `python -O`
         raise ValueError(
             f"bf16 pairwise product overlap-add would exceed 2^24 "
             f"exactness: min({bx}, {by}) blocks > {_BF16_MAX_BLOCKS} "
             f"(operands up to {_BF16_MAX_BLOCKS * _BLOCK * LIMB_BITS} "
             f"bits); use MPCIUM_MULPAIR=i8 or i32 for wider operands"
+        )
+    if min(bx, by) > _I8_MAX_BLOCKS:
+        raise ValueError(
+            f"i8 pairwise product would exceed the carry-normalization "
+            f"bound (limbs ≥ 127·2^21): min({bx}, {by}) blocks > "
+            f"{_I8_MAX_BLOCKS}; use MPCIUM_MULPAIR=i32 for wider operands"
         )
     acc_dtype = jnp.float32 if op_dtype == jnp.bfloat16 else jnp.int32
     xb = bn.take_limbs(x, 0, bx * _BLOCK).reshape(
@@ -310,10 +332,11 @@ def _mul_pair_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
 def _mul_pair_i8(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """int8 band strategy: half the band traffic of bf16, int32
-    accumulation exact at every width (no 32-block rejection — wide
-    operands take the int32 overlap-add fallback). Whether XLA maps the
-    batched K=32 contraction onto the int8 MXU path is measured on the
-    real chip by .scratch/chipcheck.py."""
+    accumulation — exact up to 256-block operands (~57k bits; past the
+    32-block f32 bound the overlap-add falls back to int32, and the
+    carry-normalization bound caps the fallback — see _mul_pair_band).
+    Whether XLA maps the batched K=32 contraction onto the int8 MXU path
+    is measured on the real chip by .scratch/chipcheck.py."""
     return _mul_pair_band(x, y, jnp.int8)
 
 
