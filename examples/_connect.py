@@ -1,0 +1,32 @@
+"""Shared example bootstrap: in-process dev cluster by default, or a
+live networked deployment with ``--config path/to/config.yaml`` (the
+reference examples' mode — they assume a running NATS+Consul+nodes
+stack, INSTALLATION.md "Start Mpcium Nodes")."""
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+
+def connect(argv: List[str]) -> Tuple[object, List[str]]:
+    """Returns (cluster, leftover_args). The cluster exposes
+    create_wallet_sync / sign_sync / reshare_sync / close regardless of
+    mode (mpcium_tpu.cluster.SyncOps)."""
+    args = list(argv)
+    if "--config" in args:
+        i = args.index("--config")
+        try:
+            cfg = args[i + 1]
+        except IndexError:
+            print("--config requires a path", file=sys.stderr)
+            raise SystemExit(2)
+        del args[i : i + 2]
+        from mpcium_tpu.cluster import RemoteCluster
+
+        return RemoteCluster(cfg), args
+    from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+
+    return (
+        LocalCluster(n_nodes=3, threshold=1, preparams=load_test_preparams()),
+        args,
+    )

@@ -1,20 +1,23 @@
-"""Create a wallet through the client SDK against an in-process 3-node
-cluster (the analogue of reference examples/generate/main.go run against a
-docker-compose stack).
+"""Create a wallet through the client SDK.
 
-Usage: python examples/generate.py [wallet-id]
+Default: an in-process 3-node cluster. With ``--config config.yaml`` the
+client connects to a RUNNING broker+daemons deployment instead (the
+reference examples/generate/main.go mode against a live stack).
+
+Usage: python examples/generate.py [--config config.yaml] [wallet-id]
 """
 import sys
 import uuid
 
-from mpcium_tpu.cluster import LocalCluster, load_test_preparams
 from mpcium_tpu.utils import log
 
 
 def main() -> int:
-    wallet_id = sys.argv[1] if len(sys.argv) > 1 else f"wallet-{uuid.uuid4().hex[:8]}"
     log.init()
-    cluster = LocalCluster(n_nodes=3, threshold=1, preparams=load_test_preparams())
+    from _connect import connect
+
+    cluster, args = connect(sys.argv[1:])
+    wallet_id = args[0] if args else f"wallet-{uuid.uuid4().hex[:8]}"
     try:
         ev = cluster.create_wallet_sync(wallet_id)
         print(f"wallet created: {ev.wallet_id}")

@@ -1,21 +1,25 @@
 """Create a wallet, then sign one EdDSA and one ECDSA transaction through
 the durable signing pipeline (the analogue of reference examples/sign).
 
-Usage: python examples/sign.py
+Default: an in-process 3-node cluster; ``--config config.yaml`` connects
+to a running broker+daemons deployment instead.
+
+Usage: python examples/sign.py [--config config.yaml]
 """
 import hashlib
 import sys
 import uuid
 
 from mpcium_tpu import wire
-from mpcium_tpu.cluster import LocalCluster, load_test_preparams
 from mpcium_tpu.core import hostmath as hm
 from mpcium_tpu.utils import log
 
 
 def main() -> int:
     log.init()
-    cluster = LocalCluster(n_nodes=3, threshold=1, preparams=load_test_preparams())
+    from _connect import connect
+
+    cluster, args = connect(sys.argv[1:])
     try:
         wallet_id = f"wallet-{uuid.uuid4().hex[:8]}"
         ev = cluster.create_wallet_sync(wallet_id)

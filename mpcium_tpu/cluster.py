@@ -28,7 +28,84 @@ from .transport.loopback import LoopbackFabric
 from .utils import log
 
 
-class LocalCluster:
+class _NotMine(Exception):
+    """Result event for a different operation: raising naks it back to
+    the work queue (transport/api.py:20 contract) so a concurrent waiter
+    can dequeue it, instead of silently ack-and-discarding another
+    client's result. (Like the reference, result queues remain work
+    queues — one dequeuer wins per event; unclaimed mismatches
+    eventually dead-letter after max redeliveries.)"""
+
+
+class SyncOps:
+    """Blocking convenience wrappers over an :class:`MPCClient` at
+    ``self.client`` — shared by :class:`LocalCluster` (in-process) and
+    :class:`RemoteCluster` (networked broker)."""
+
+    @staticmethod
+    def _await_result(subscribe, fire, matches, timeout_s, what: str):
+        import threading
+
+        done = threading.Event()
+        box: list = []
+
+        def on_ev(ev):
+            if not matches(ev):
+                raise _NotMine(what)
+            box.append(ev)
+            done.set()
+
+        sub = subscribe(on_ev)
+        try:
+            fire()
+            if not done.wait(timeout_s):
+                raise TimeoutError(f"{what} produced no result in time")
+            return box[0]
+        finally:
+            sub.unsubscribe()
+
+    def create_wallet_sync(
+        self, wallet_id: str, timeout_s: float = 600.0
+    ) -> wire.KeygenSuccessEvent:
+        ev = self._await_result(
+            self.client.on_wallet_creation_result,
+            lambda: self.client.create_wallet(wallet_id),
+            lambda ev: ev.wallet_id == wallet_id,
+            timeout_s,
+            f"wallet {wallet_id!r} creation",
+        )
+        if ev.result_type != wire.RESULT_SUCCESS:
+            raise RuntimeError(f"keygen failed: {ev.error_reason}")
+        return ev
+
+    def sign_sync(
+        self, msg: wire.SignTxMessage, timeout_s: float = 600.0
+    ) -> wire.SigningResultEvent:
+        return self._await_result(
+            self.client.on_sign_result,
+            lambda: self.client.sign_transaction(msg),
+            lambda ev: ev.tx_id == msg.tx_id,
+            timeout_s,
+            f"tx {msg.tx_id!r}",
+        )
+
+    def reshare_sync(
+        self, wallet_id: str, new_threshold: int, key_type: str,
+        timeout_s: float = 600.0,
+    ) -> wire.ResharingSuccessEvent:
+        ev = self._await_result(
+            self.client.on_resharing_result,
+            lambda: self.client.resharing(wallet_id, new_threshold, key_type),
+            lambda ev: ev.wallet_id == wallet_id and ev.key_type == key_type,
+            timeout_s,
+            f"wallet {wallet_id!r} resharing",
+        )
+        if ev.result_type != wire.RESULT_SUCCESS:
+            raise RuntimeError(f"resharing failed: {ev.error_reason}")
+        return ev
+
+
+class LocalCluster(SyncOps):
     """n identical in-process MPC nodes + a client over loopback."""
 
     def __init__(
@@ -115,73 +192,6 @@ class LocalCluster:
         log.info("local cluster ready", nodes=n_nodes, threshold=threshold)
         self.client = MPCClient(self._mk_transport(), self.initiator)
 
-    # -- convenience blocking APIs (examples/tests) -------------------------
-
-    def create_wallet_sync(
-        self, wallet_id: str, timeout_s: float = 600.0
-    ) -> wire.KeygenSuccessEvent:
-        import threading
-
-        done = threading.Event()
-        box: list = []
-
-        sub = self.client.on_wallet_creation_result(
-            lambda ev: (box.append(ev), done.set())
-        )
-        try:
-            self.client.create_wallet(wallet_id)
-            if not done.wait(timeout_s):
-                raise TimeoutError(f"wallet {wallet_id!r} not created in time")
-            if box[0].result_type != wire.RESULT_SUCCESS:
-                raise RuntimeError(f"keygen failed: {box[0].error_reason}")
-            return box[0]
-        finally:
-            sub.unsubscribe()
-
-    def sign_sync(
-        self, msg: wire.SignTxMessage, timeout_s: float = 600.0
-    ) -> wire.SigningResultEvent:
-        import threading
-
-        done = threading.Event()
-        box: list = []
-
-        def on_result(ev: wire.SigningResultEvent):
-            if ev.tx_id == msg.tx_id:
-                box.append(ev)
-                done.set()
-
-        sub = self.client.on_sign_result(on_result)
-        try:
-            self.client.sign_transaction(msg)
-            if not done.wait(timeout_s):
-                raise TimeoutError(f"tx {msg.tx_id!r} not signed in time")
-            return box[0]
-        finally:
-            sub.unsubscribe()
-
-    def reshare_sync(
-        self, wallet_id: str, new_threshold: int, key_type: str,
-        timeout_s: float = 600.0,
-    ) -> wire.ResharingSuccessEvent:
-        import threading
-
-        done = threading.Event()
-        box: list = []
-
-        sub = self.client.on_resharing_result(
-            lambda ev: (box.append(ev), done.set())
-        )
-        try:
-            self.client.resharing(wallet_id, new_threshold, key_type)
-            if not done.wait(timeout_s):
-                raise TimeoutError(f"wallet {wallet_id!r} not reshared in time")
-            if box[0].result_type != wire.RESULT_SUCCESS:
-                raise RuntimeError(f"resharing failed: {box[0].error_reason}")
-            return box[0]
-        finally:
-            sub.unsubscribe()
-
     def close(self) -> None:
         for ec in self.consumers:
             ec.close()
@@ -193,6 +203,47 @@ class LocalCluster:
             self.fabric.close()
         if self.broker is not None:
             self.broker.close()
+
+
+class RemoteCluster(SyncOps):
+    """Client-side handle to an ALREADY RUNNING networked deployment
+    (broker + daemons — the docker-compose topology): the analogue of the
+    reference examples connecting to a live NATS+Consul stack
+    (INSTALLATION.md "Start Mpcium Nodes"; examples/generate/main.go).
+
+    Reads broker endpoint/auth/encryption from the same config file the
+    daemons use and loads the initiator's PRIVATE key (default:
+    ``event_initiator.key`` next to the config, the client.go:64-146
+    layout)."""
+
+    def __init__(
+        self,
+        config_path: str,
+        initiator_key_path: Optional[str] = None,
+        passphrase: Optional[str] = None,
+    ):
+        from .config import init_config
+        from .transport.tcp import parse_addrs, tcp_transport
+
+        cfg = init_config(path=str(config_path))
+        key_path = Path(
+            initiator_key_path
+            or Path(config_path).resolve().parent / "event_initiator.key"
+        )
+        # load the key BEFORE connecting: a missing/locked key must not
+        # leak a live authenticated broker connection + reader thread
+        initiator = InitiatorKey.load(key_path, passphrase)
+        self.transport = tcp_transport(
+            cfg.broker_host,
+            cfg.broker_port,
+            auth_token=cfg.broker_token or None,
+            encrypt=cfg.broker_encrypt,
+            standbys=parse_addrs(cfg.broker_standbys) or None,
+        )
+        self.client = MPCClient(self.transport, initiator)
+
+    def close(self) -> None:
+        self.transport.client.close()
 
 
 def load_test_preparams(bits: int = 2048) -> Dict[str, PreParams]:

@@ -286,3 +286,20 @@ def test_reshare_then_sign(stack, wallet):
         bytes.fromhex(ev.pub_key or wallet.eddsa_pub_key), tx,
         bytes.fromhex(sev.signature),
     )
+
+
+def test_example_networked_mode(stack, wallet):
+    """examples/generate.py --config drives the SAME running deployment
+    (RemoteCluster): the reference examples' mode against a live stack."""
+    ws, _ = stack
+    r = subprocess.run(
+        [
+            sys.executable, str(REPO / "examples" / "generate.py"),
+            "--config", str(ws / "config.yaml"),
+            "wallet-example-net",
+        ],
+        env=_child_env(), cwd=ws, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "wallet created: wallet-example-net" in r.stdout
