@@ -29,9 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 # XLA flags). Caveat: XLA CPU AOT deserialization can rarely segfault in
 # very long single processes on this host — run the suite per file
 # (`make test-all`) for crash isolation; every subset is green.
-jax.config.update("jax_compilation_cache_dir", 
-                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache_tests"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# MPCIUM_TESTS_NO_CACHE=1 disables it — the Makefile's test-all retries a
+# crashed file this way, since a poisoned/mismatched AOT entry (e.g.
+# machine-feature mismatch) can segfault the deserializer
+if not os.environ.get("MPCIUM_TESTS_NO_CACHE"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(__file__), "..", ".jax_cache_tests"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
