@@ -262,3 +262,18 @@ def test_registry_ready_flow():
     regs["n0"]._poll_once()
     assert not regs["n0"].all_ready()
     assert regs["n0"].ready_peers() == ["n0", "n1"]
+
+
+def test_remote_cluster_loads_key_before_connecting(tmp_path):
+    """A missing initiator key must fail BEFORE any broker connection is
+    attempted (no leaked authenticated connection + reader thread): with
+    no broker listening, connecting first would surface a connection
+    error instead of the key error."""
+    import pytest
+
+    from mpcium_tpu.cluster import RemoteCluster
+
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("broker_host: 127.0.0.1\nbroker_port: 1\n")
+    with pytest.raises(FileNotFoundError):
+        RemoteCluster(str(cfg))
