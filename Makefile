@@ -17,10 +17,13 @@ test:
 test-all:
 	@set -e; for f in tests/test_*.py; do \
 	  echo "== $$f"; \
-	  $(PY) -m pytest "$$f" -q --no-header || { \
-	    echo "== retrying without compile cache (AOT flake isolation): $$f"; \
+	  rc=0; $(PY) -m pytest "$$f" -q --no-header || rc=$$?; \
+	  if [ $$rc -ge 128 ]; then \
+	    echo "== crash (rc=$$rc); retrying without compile cache (AOT flake isolation): $$f"; \
 	    MPCIUM_TESTS_NO_CACHE=1 $(PY) -m pytest "$$f" -q --no-header; \
-	  }; \
+	  elif [ $$rc -ne 0 ]; then \
+	    echo "== FAILED (rc=$$rc): $$f"; exit $$rc; \
+	  fi; \
 	done
 
 bench:

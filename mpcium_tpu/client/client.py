@@ -56,30 +56,62 @@ class MPCClient:
     # -- results ------------------------------------------------------------
 
     def on_wallet_creation_result(
-        self, handler: Callable[[wire.KeygenSuccessEvent], None]
+        self,
+        handler: Callable[[wire.KeygenSuccessEvent], None],
+        wallet_id: str | None = None,
     ):
+        """Subscribe to keygen results. Results are published to per-wallet
+        topics (TOPIC_KEYGEN_RESULT.{wallet_id}); passing ``wallet_id``
+        narrows the work-queue subscription to that wallet, so concurrent
+        clients on one broker can't steal (and eventually dead-letter)
+        each other's results via round-robin delivery."""
+        topic = (
+            f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}"
+            if wallet_id is not None
+            else f"{wire.TOPIC_KEYGEN_RESULT}.*"
+        )
         return self.transport.queues.dequeue(
-            f"{wire.TOPIC_KEYGEN_RESULT}.*",
+            topic,
             lambda raw: handler(
                 wire.KeygenSuccessEvent.from_json(json.loads(raw))
             ),
         )
 
     def on_sign_result(
-        self, handler: Callable[[wire.SigningResultEvent], None]
+        self,
+        handler: Callable[[wire.SigningResultEvent], None],
+        tx_id: str | None = None,
     ):
+        """Subscribe to signing results. Like keygen/resharing, results
+        land on per-tx topics (TOPIC_SIGNING_RESULT.{tx_id}); passing
+        ``tx_id`` scopes the work-queue subscription so concurrent
+        clients can't round-robin-steal each other's results."""
+        topic = (
+            f"{wire.TOPIC_SIGNING_RESULT}.{tx_id}"
+            if tx_id is not None
+            else f"{wire.TOPIC_SIGNING_RESULT}.*"
+        )
         return self.transport.queues.dequeue(
-            wire.TOPIC_SIGNING_RESULT,
+            topic,
             lambda raw: handler(
                 wire.SigningResultEvent.from_json(json.loads(raw))
             ),
         )
 
     def on_resharing_result(
-        self, handler: Callable[[wire.ResharingSuccessEvent], None]
+        self,
+        handler: Callable[[wire.ResharingSuccessEvent], None],
+        wallet_id: str | None = None,
     ):
+        """Subscribe to resharing results; ``wallet_id`` narrows to that
+        wallet's topic (see :meth:`on_wallet_creation_result`)."""
+        topic = (
+            f"{wire.TOPIC_RESHARING_RESULT}.{wallet_id}"
+            if wallet_id is not None
+            else f"{wire.TOPIC_RESHARING_RESULT}.*"
+        )
         return self.transport.queues.dequeue(
-            f"{wire.TOPIC_RESHARING_RESULT}.*",
+            topic,
             lambda raw: handler(
                 wire.ResharingSuccessEvent.from_json(json.loads(raw))
             ),

@@ -67,8 +67,15 @@ class SyncOps:
     def create_wallet_sync(
         self, wallet_id: str, timeout_s: float = 600.0
     ) -> wire.KeygenSuccessEvent:
+        # keygen results land on per-wallet topics — subscribe to OUR
+        # wallet's topic so concurrent clients on one broker never
+        # round-robin-steal (and after max_deliver naks, dead-letter)
+        # each other's results. The matches() predicate stays as a
+        # belt-and-braces check.
         ev = self._await_result(
-            self.client.on_wallet_creation_result,
+            lambda h: self.client.on_wallet_creation_result(
+                h, wallet_id=wallet_id
+            ),
             lambda: self.client.create_wallet(wallet_id),
             lambda ev: ev.wallet_id == wallet_id,
             timeout_s,
@@ -82,7 +89,7 @@ class SyncOps:
         self, msg: wire.SignTxMessage, timeout_s: float = 600.0
     ) -> wire.SigningResultEvent:
         return self._await_result(
-            self.client.on_sign_result,
+            lambda h: self.client.on_sign_result(h, tx_id=msg.tx_id),
             lambda: self.client.sign_transaction(msg),
             lambda ev: ev.tx_id == msg.tx_id,
             timeout_s,
@@ -94,7 +101,7 @@ class SyncOps:
         timeout_s: float = 600.0,
     ) -> wire.ResharingSuccessEvent:
         ev = self._await_result(
-            self.client.on_resharing_result,
+            lambda h: self.client.on_resharing_result(h, wallet_id=wallet_id),
             lambda: self.client.resharing(wallet_id, new_threshold, key_type),
             lambda ev: ev.wallet_id == wallet_id and ev.key_type == key_type,
             timeout_s,

@@ -996,7 +996,7 @@ class BatchSigningScheduler:
                         error_reason="batched signature failed verification",
                     )
                 self.transport.queues.enqueue(
-                    wire.TOPIC_SIGNING_RESULT,
+                    f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
                     wire.canonical_json(ev.to_json()),
                     idempotency_key=msg.tx_id,
                 )

@@ -257,7 +257,7 @@ class EventConsumer:
                 is_timeout=timeout,
             )
             self.transport.queues.enqueue(
-                wire.TOPIC_SIGNING_RESULT,
+                f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
                 wire.canonical_json(ev.to_json()),
                 idempotency_key=msg.tx_id,
             )
@@ -288,7 +288,7 @@ class EventConsumer:
                         signature=result.hex(),
                     )
                 self.transport.queues.enqueue(
-                    wire.TOPIC_SIGNING_RESULT,
+                    f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
                     wire.canonical_json(ev.to_json()),
                     idempotency_key=msg.tx_id,
                 )

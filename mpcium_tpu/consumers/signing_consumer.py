@@ -79,7 +79,7 @@ class TimeoutConsumer:
             is_timeout=True,
         )
         self.transport.queues.enqueue(
-            wire.TOPIC_SIGNING_RESULT,
+            f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
             wire.canonical_json(ev.to_json()),
             idempotency_key=msg.tx_id,
         )

@@ -44,6 +44,7 @@ import numpy as np
 from jax import lax
 
 from ..core import bignum as bn
+from ..utils import log
 
 
 def _jit_method(fn=None, *, static_argnums=(0,)):
@@ -198,6 +199,35 @@ COMB_W = int(os.environ.get("MPCIUM_COMB_W", "8"))
 # Dispatch audit: set to a dict to accumulate mulmod-equivalent counts
 # per (op, modulus-bits); None disables (no overhead on the hot path).
 AUDIT = None
+
+# Cumulative device-resident comb/constant table bytes across ALL contexts
+# in this process. COMB_W=8 costs ~16x the table memory of w=4 (~100 MB
+# per (base, 2048-bit modulus) comb, ~200 MB per counterparty NTilde), so
+# larger committees can pressure HBM with nothing attributing it; each
+# build is logged and crossing the soft cap warns once per GB.
+_FB_TABLE_BYTES = 0
+_FB_TABLE_WARN_GB = float(os.environ.get("MPCIUM_FB_TABLE_WARN_GB", "4"))
+
+
+def _track_fb_table(nbytes: int, what: str, mod_bits: int) -> None:
+    global _FB_TABLE_BYTES
+    prev_gb = _FB_TABLE_BYTES / (1 << 30)
+    _FB_TABLE_BYTES += nbytes
+    now_gb = _FB_TABLE_BYTES / (1 << 30)
+    log.debug(
+        "fixed-base table built", kind=what, mod_bits=mod_bits,
+        table_mb=round(nbytes / (1 << 20), 1),
+        cumulative_mb=round(_FB_TABLE_BYTES / (1 << 20), 1),
+    )
+    if _FB_TABLE_WARN_GB > 0 and (
+        int(now_gb / _FB_TABLE_WARN_GB) > int(prev_gb / _FB_TABLE_WARN_GB)
+    ):
+        log.warn(
+            "cumulative fixed-base table memory crossed soft cap — "
+            "HBM pressure is likely attributable to comb tables; "
+            "lower MPCIUM_COMB_W or raise MPCIUM_FB_TABLE_WARN_GB",
+            cumulative_gb=round(now_gb, 2), soft_cap_gb=_FB_TABLE_WARN_GB,
+        )
 
 # Largest block count for which the bf16 overlap-add stays f32-exact:
 # each 32-limb block-product column is ≤ 32·127² = 516,128 and the
@@ -604,6 +634,10 @@ class MXUBarrett:
                 value % self.modulus, self.prof.n_limbs, min_limbs=self.occ
             )
             self._fb_tables[key] = T
+            _track_fb_table(
+                sum(int(t.nbytes) for t in jax.tree.leaves(T)),
+                "constT", self.modulus.bit_length(),
+            )
         self._audit("mulmod_const", 0.5)
         return _k_mulmod_const(
             a, T, self._T_mu, self._T_m, self._comp, self.occ,
@@ -684,6 +718,9 @@ class MXUBarrett:
                 )
             )
             self._fb_tables[key] = tbl
+            _track_fb_table(
+                int(tbl.nbytes), "comb", self.modulus.bit_length()
+            )
         return _k_powmod_fb(
             tbl, ebits, self._T_mu, self._T_m, self._comp, self.occ,
             self.prof.n_limbs,

@@ -36,8 +36,12 @@ def _run_isolated(test_name: str) -> None:
             f"isolated {test_name} timed out:\n"
             f"{(e.stdout or '')[-2000:]}{(e.stderr or '')[-1000:]}"
         )
-    # -11 = SIGSEGV, -6 = SIGABRT (XLA CHECK failure -> abort)
-    if r.returncode in (-11, -6):
+    # -11 = SIGSEGV, -6 = SIGABRT (XLA CHECK failure -> abort). The
+    # crash->xfail downgrade is opt-in (set MPCIUM_XFAIL_XLA_CRASH=1 on
+    # the one known-bad host): a blanket downgrade would let a real crash
+    # regression in the DKG->sign / reshare paths merge green everywhere.
+    if (r.returncode in (-11, -6)
+            and os.environ.get("MPCIUM_XFAIL_XLA_CRASH") == "1"):
         pytest.xfail(
             "XLA:CPU crashed compiling this test's graphs on this host "
             "(known host-specific codegen crash; green on healthy hosts)"
