@@ -30,7 +30,7 @@ printed):
     secondary metrics (a degraded small-batch number exists to beat rc=1,
     not to measure; MPCIUM_BENCH_SECONDARY=1 forces them back on).
 
-Env knobs: MPCIUM_BENCH_B (batch, default 1024 tpu / 8 cpu),
+Env knobs: MPCIUM_BENCH_B (batch, default 1024 tpu / 2 cpu),
 MPCIUM_BENCH_RUNS (timed runs, default 1), MPCIUM_BENCH_NO_SECONDARY=1 /
 MPCIUM_BENCH_SECONDARY=1 (secondary metrics off/on override),
 MPCIUM_BENCH_WATCHDOG_S (watchdog deadline, 0 disables).
@@ -206,10 +206,11 @@ def _arm_watchdog(platform: str) -> None:
 def main() -> None:
     platform = _ensure_backend()
     _arm_watchdog(platform)
-    default_b = "1024" if platform == "tpu" else "8"
-    # CPU fallback shrinks the batch: full-size GG18 at B=1024 is hours of
-    # single-core arithmetic — a small-batch number with platform: "cpu"
-    # is the honest degraded result (explicit MPCIUM_BENCH_B overrides)
+    default_b = "1024" if platform == "tpu" else "2"
+    # CPU fallback shrinks the batch: full-size GG18 at even B=8 is ~8 min
+    # of single-core arithmetic after a ~30 min compile — B=2 is the
+    # honest degraded result (explicit MPCIUM_BENCH_B overrides), and the
+    # per-host cache is kept warm at B=2 so a fallback run stays ~2 min
     B = int(os.environ.get("MPCIUM_BENCH_B", default_b))
     runs = int(os.environ.get("MPCIUM_BENCH_RUNS", "1"))
 

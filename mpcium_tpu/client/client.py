@@ -19,6 +19,12 @@ from ..transport.api import Transport
 from ..utils import log
 
 
+def _result_topic(base: str, scope_id: Optional[str]) -> str:
+    """Result topics are per-wallet/per-tx (``base.{id}``); a scoped
+    subscription sees only its own result, the wildcard sees all."""
+    return f"{base}.{scope_id}" if scope_id is not None else f"{base}.*"
+
+
 class MPCClient:
     def __init__(self, transport: Transport, initiator: InitiatorKey):
         self.transport = transport
@@ -65,13 +71,8 @@ class MPCClient:
         narrows the work-queue subscription to that wallet, so concurrent
         clients on one broker can't steal (and eventually dead-letter)
         each other's results via round-robin delivery."""
-        topic = (
-            f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}"
-            if wallet_id is not None
-            else f"{wire.TOPIC_KEYGEN_RESULT}.*"
-        )
         return self.transport.queues.dequeue(
-            topic,
+            _result_topic(wire.TOPIC_KEYGEN_RESULT, wallet_id),
             lambda raw: handler(
                 wire.KeygenSuccessEvent.from_json(json.loads(raw))
             ),
@@ -86,13 +87,8 @@ class MPCClient:
         land on per-tx topics (TOPIC_SIGNING_RESULT.{tx_id}); passing
         ``tx_id`` scopes the work-queue subscription so concurrent
         clients can't round-robin-steal each other's results."""
-        topic = (
-            f"{wire.TOPIC_SIGNING_RESULT}.{tx_id}"
-            if tx_id is not None
-            else f"{wire.TOPIC_SIGNING_RESULT}.*"
-        )
         return self.transport.queues.dequeue(
-            topic,
+            _result_topic(wire.TOPIC_SIGNING_RESULT, tx_id),
             lambda raw: handler(
                 wire.SigningResultEvent.from_json(json.loads(raw))
             ),
@@ -105,13 +101,8 @@ class MPCClient:
     ):
         """Subscribe to resharing results; ``wallet_id`` narrows to that
         wallet's topic (see :meth:`on_wallet_creation_result`)."""
-        topic = (
-            f"{wire.TOPIC_RESHARING_RESULT}.{wallet_id}"
-            if wallet_id is not None
-            else f"{wire.TOPIC_RESHARING_RESULT}.*"
-        )
         return self.transport.queues.dequeue(
-            topic,
+            _result_topic(wire.TOPIC_RESHARING_RESULT, wallet_id),
             lambda raw: handler(
                 wire.ResharingSuccessEvent.from_json(json.loads(raw))
             ),

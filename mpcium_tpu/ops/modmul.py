@@ -427,9 +427,33 @@ def _k_reduce(x, T_mu, T_m, comp, occ: int, n: int):
     return _reduce_impl(x, T_mu, T_m, comp, occ, n)
 
 
+# Pairwise-mulmod implementation: "band" = Toeplitz-band GEMM + XLA-fused
+# Barrett (the round-4 default); "pallas" = the fully fused VMEM-resident
+# kernel in ops.pallas_mulmod (conv + carries + Barrett legs in ONE
+# pallas_call — no HBM round-trips between stages). Module-level so the
+# choice is uniform across every powmod/mulmod kernel in a process.
+MULMOD_IMPL = os.environ.get("MPCIUM_MULMOD", "band")
+if MULMOD_IMPL not in ("band", "pallas"):
+    raise ValueError(
+        f"MPCIUM_MULMOD={MULMOD_IMPL!r}: expected 'band' or 'pallas'"
+    )
+
+
+def _mm(a, b, T_mu, T_m, comp, occ: int, n: int) -> jnp.ndarray:
+    """a·b mod m — the one mul+reduce step every kernel below loops."""
+    if MULMOD_IMPL == "pallas":
+        from . import pallas_mulmod
+
+        return pallas_mulmod.mulmod(
+            a, b, T_mu, T_m, comp, occ, n,
+            interpret=jax.default_backend() == "cpu",
+        )
+    return _reduce_impl(mul_pair(a, b), T_mu, T_m, comp, occ, n)
+
+
 @functools.partial(jax.jit, static_argnames=("occ", "n"))
 def _k_mulmod(a, b, T_mu, T_m, comp, occ: int, n: int):
-    return _reduce_impl(mul_pair(a, b), T_mu, T_m, comp, occ, n)
+    return _mm(a, b, T_mu, T_m, comp, occ, n)
 
 
 @functools.partial(jax.jit, static_argnames=("occ", "n"))
@@ -475,16 +499,16 @@ def _k_powmod(x, ebits, T_mu, T_m, comp, occ: int, n: int):
     )
     rows = [_one_like(x, n), x]
     for _ in range(14):
-        rows.append(_reduce_impl(mul_pair(rows[-1], x), T_mu, T_m, comp, occ, n))
+        rows.append(_mm(rows[-1], x, T_mu, T_m, comp, occ, n))
     tbl = jnp.stack(rows, axis=-2)
 
     def step(acc, d):
         for _ in range(4):
-            acc = _reduce_impl(mul_pair(acc, acc), T_mu, T_m, comp, occ, n)
+            acc = _mm(acc, acc, T_mu, T_m, comp, occ, n)
         sel = jnp.take_along_axis(
             tbl, d[..., None, None].astype(jnp.int32), axis=-2
         )[..., 0, :]
-        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+        return _mm(acc, sel, T_mu, T_m, comp, occ, n), None
 
     acc, _ = lax.scan(step, _one_like(x, n), jnp.moveaxis(digits, -1, 0),
                       unroll=SCAN_UNROLL)
@@ -497,14 +521,14 @@ def _k_powmod_digits(x, digits, T_mu, T_m, comp, occ: int, n: int):
     array (value is a runtime operand: one compile per digit COUNT)."""
     rows = [_one_like(x, n), x]
     for _ in range(14):
-        rows.append(_reduce_impl(mul_pair(rows[-1], x), T_mu, T_m, comp, occ, n))
+        rows.append(_mm(rows[-1], x, T_mu, T_m, comp, occ, n))
     tbl = jnp.stack(rows, axis=-2)
 
     def step(acc, d):
         for _ in range(4):
-            acc = _reduce_impl(mul_pair(acc, acc), T_mu, T_m, comp, occ, n)
+            acc = _mm(acc, acc, T_mu, T_m, comp, occ, n)
         sel = tbl[..., d, :]
-        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+        return _mm(acc, sel, T_mu, T_m, comp, occ, n), None
 
     acc, _ = lax.scan(step, _one_like(x, n), digits, unroll=SCAN_UNROLL)
     return acc
@@ -529,7 +553,7 @@ def _k_powmod_fb(tbl, ebits, T_mu, T_m, comp, occ: int, n: int):
     def step(acc, sl):
         d, rows = sl
         sel = rows[d]
-        return _reduce_impl(mul_pair(acc, sel), T_mu, T_m, comp, occ, n), None
+        return _mm(acc, sel, T_mu, T_m, comp, occ, n), None
 
     acc, _ = lax.scan(
         step, _one_like(ebits, n), (jnp.moveaxis(digits, -1, 0), tbl),

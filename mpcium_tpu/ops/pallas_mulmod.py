@@ -1,0 +1,244 @@
+"""Fused Pallas TPU kernel for batched mulmod (the GG18 hot op).
+
+The XLA band-GEMM path (`ops.modmul._k_mulmod`) materializes the Toeplitz
+band (~78 MB bf16 at B=1024/4096-bit) and the block products (~93 MB f32)
+in HBM between fusions — PERFORMANCE.md "kernel gaps" #1 puts the
+resulting traffic floor at ~0.25-0.35 ms out of the measured 1.82 ms
+mulmod. This kernel keeps the ENTIRE mulmod — pairwise product, carry
+normalization, both Barrett constant legs, and the trailing conditional
+subtractions — inside one `pallas_call`, so per batch-tile the only HBM
+traffic is x, y in and the result out (~0.9 MB per 128 rows at 4096-bit
+vs ~170 MB total today).
+
+Design notes (why it looks nothing like a GPU bignum kernel):
+
+* **The pairwise product cannot ride the MXU.** A batched x·y product
+  needs a per-element operand matrix (the Toeplitz band of y_b differs
+  for every b), and the systolic array only amortizes SHARED operands.
+  Instead the product runs on the VPU as a shift-and-FMA convolution in
+  f32 — exact, because 7-bit limbs give partial products ≤ 127² and any
+  convolution column sums ≤ `occ` of them: occ·127² < 2²⁴ for moduli up
+  to ~7280 bits (the same exactness budget `ops.modmul.mul_const` uses).
+  Eight phase accumulators S_r (r = 0..7) turn 1-lane shifts into one
+  8-lane shift per 8 FMA sweeps:
+      conv = Σ_r shift_r(S_r),   S_r = Σ_q shift_{8q}(x) · y[8q+r]
+* **The Barrett legs DO ride the MXU.** µ and m are shared across the
+  batch, so `q1 @ T_µ` and `q3 @ T_m` are plain 2D bf16 matmuls with f32
+  accumulation (bit-exact below 2²⁴), issued from inside the kernel on
+  VMEM-resident constant tiles that persist across grid steps.
+* **Carries are lane-axis passes.** Three shift-and-add roll passes bound
+  limbs ≤ 135, then a Hillis–Steele doubling pass over the
+  generate/propagate semiring replaces `lax.associative_scan` (which
+  Mosaic does not lower). All shifts are static `jnp.concatenate` slices
+  — no `pltpu.roll` — so the kernel also runs under `interpret=True` for
+  CPU-exactness tests.
+
+Same reduction algebra as `ops.modmul._reduce_impl` (HAC Alg. 14.42);
+bit-for-bit equality against `core.bignum` host ints is property-tested
+in tests/test_pallas_mulmod.py. Selected via MPCIUM_MULMOD=pallas (see
+`ops.modmul.mulmod`). Reference correspondence: this executes the
+tss-lib Paillier/MtA arithmetic the reference delegates to
+(SURVEY.md §2.3); the leading axis is the concurrent-session batch.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LIMB_BITS = 7
+MASK = (1 << LIMB_BITS) - 1
+
+
+def _roundup(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _shift_up(x: jnp.ndarray, k: int, fill: int = 0):
+    """shift limbs toward HIGHER lane index by k (value · R^k), static k."""
+    if k == 0:
+        return x
+    pad = jnp.full(x.shape[:-1] + (k,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-k]], axis=-1)
+
+
+def _carry_int(v: jnp.ndarray) -> jnp.ndarray:
+    """Exact carry normalization along the lane axis — the in-kernel
+    port of `ops.modmul.carry` (3 roll passes then carry-lookahead; input
+    contract limb < 127·2²¹). The lookahead runs as a Hillis–Steele
+    doubling over the (generate, propagate) semiring: identity shifts in
+    g=0 / p=1."""
+    for _ in range(3):
+        v = (v & MASK) + _shift_up(v >> LIMB_BITS, 1)
+    g = v >> LIMB_BITS  # 0/1 after the roll passes
+    r = v & MASK
+    p = (r == MASK).astype(jnp.int32)
+    d = 1
+    n = v.shape[-1]
+    while d < n:
+        gs = _shift_up(g, d, fill=0)
+        ps = _shift_up(p, d, fill=1)
+        g = g | (p & gs)
+        p = p & ps
+        d *= 2
+    return (r + _shift_up(g, 1)) & MASK
+
+
+def _mulmod_kernel(
+    x_ref, y_ref, tmu_ref, tm_ref, comp_ref, out_ref, *, occ: int,
+    n_pad: int, frame: int, l1: int
+):
+    tb = x_ref.shape[0]
+    f32 = jnp.float32
+
+    # ---- stage 1: pairwise product as a VPU shift-FMA convolution -----
+    xf = jnp.pad(
+        x_ref[:].astype(f32), ((0, 0), (0, frame - n_pad))
+    )  # (tb, frame)
+    nq = -(-occ // 8)  # 8·nq ≤ n_pad (x/y zero above occ)
+
+    def q_body(q, st):
+        xc = st[0]
+        ss = list(st[1:])
+        yq = y_ref[:, pl.ds(8 * q, 8)].astype(f32)  # (tb, 8)
+        for r in range(8):
+            ss[r] = ss[r] + xc * yq[:, r:r + 1]
+        return (_shift_up(xc, 8),) + tuple(ss)
+
+    zeros = jnp.zeros((tb, frame), f32)
+    st = lax.fori_loop(
+        0, nq, q_body, (xf,) + tuple(zeros for _ in range(8))
+    )
+    acc = st[1]
+    for r in range(1, 8):
+        acc = acc + _shift_up(st[1 + r], r)
+
+    # f32 column sums ≤ occ·127² < 2²⁴ ⇒ exact; normalize in int32
+    prod = _carry_int(acc.astype(jnp.int32))  # (tb, frame)
+
+    # ---- stage 2: Barrett reduction (MXU constant legs) ----------------
+    # q1 = prod >> (occ-1) limbs over the 2n-limb product window
+    q1 = prod[:, occ - 1:occ - 1 + l1]  # (tb, l1)
+    q2 = _carry_int(
+        jnp.dot(
+            q1.astype(jnp.bfloat16), tmu_ref[:],
+            preferred_element_type=f32,
+        ).astype(jnp.int32)
+    )  # (tb, c1)
+    q3 = q2[:, occ + 1:]  # (tb, l3)
+    # only limbs [0, occ+1) of q3·m are consumed; carries propagate
+    # upward, so the Toeplitz is pre-sliced to occ+2 columns
+    q3m = _carry_int(
+        jnp.dot(
+            q3.astype(jnp.bfloat16), tm_ref[:],
+            preferred_element_type=f32,
+        ).astype(jnp.int32)
+    )  # (tb, occ+2)
+
+    # r = x - q3·m over occ+1 limbs via the elementwise radix complement
+    # (keeps limbs non-negative for the carry; the spurious R^(occ+1)
+    # lands exactly in limb occ+1 and is dropped by the slice)
+    one0 = jnp.pad(
+        jnp.ones((tb, 1), jnp.int32), ((0, 0), (0, occ + 1))
+    )
+    t = jnp.pad(
+        prod[:, :occ + 1] + (MASK - q3m[:, :occ + 1]),
+        ((0, 0), (0, 1)),
+    ) + one0
+    r1 = _carry_int(t)[:, :occ + 1]
+
+    comp = comp_ref[:]  # (1, occ+2)
+
+    def cond_sub(rr):
+        u = _carry_int(jnp.pad(rr, ((0, 0), (0, 1))) + comp)
+        ge = (u[:, occ + 1] >= 1)[:, None]
+        return jnp.where(ge, u[:, :occ + 1], rr)
+
+    r1 = cond_sub(cond_sub(r1))
+    out_ref[:] = jnp.pad(r1[:, :occ], ((0, 0), (0, n_pad - occ)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("occ", "n", "tb", "interpret"),
+)
+def _mulmod_call(
+    x, y, tmu_p, tm_p, comp_p, occ: int, n: int, tb: int, interpret: bool
+):
+    """Single fused mulmod dispatch. x, y: (B, n) normalized int32 limbs,
+    B a multiple of tb. Constants pre-padded by `_consts_for`."""
+    b = x.shape[0]
+    n_pad = _roundup(n, 128)
+    # conv frame: highest nonzero conv lane < 2·occ + 14 (phase shifts);
+    # Barrett's q1 window needs lanes < 2n
+    frame = _roundup(max(2 * n, 2 * occ + 16), 128)
+    l1 = 2 * n - occ + 1
+    xp = jnp.pad(x, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y, ((0, 0), (0, n_pad - n)))
+    kernel = functools.partial(
+        _mulmod_kernel, occ=occ, n_pad=n_pad, frame=frame, l1=l1
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_pad), jnp.int32),
+        grid=(b // tb,),
+        in_specs=[
+            pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(tmu_p.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(tm_p.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(comp_p.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xp, yp, tmu_p, tm_p, comp_p)
+    return out[:, :n]
+
+
+def _consts_for(T_mu, T_m, comp, occ: int, n: int):
+    """Kernel-shaped views of the MXUBarrett operands: T_mu sliced to the
+    q1 row count, T_m to the q3 rows × (occ+2) consumed columns, comp as
+    a broadcastable row."""
+    l1 = 2 * n - occ + 1
+    tmu_p = T_mu[:l1]  # (l1, c1)
+    c1 = tmu_p.shape[1]
+    l3 = c1 - occ - 1
+    tm_p = T_m[:l3, :occ + 2]
+    comp_p = comp.reshape(1, occ + 2).astype(jnp.int32)
+    return tmu_p, tm_p, comp_p
+
+
+def _pick_tile(b: int) -> int:
+    for tb in (64, 32, 16, 8):
+        if b % tb == 0:
+            return tb
+    return 0  # pad to 8 below
+
+
+def mulmod(a, b, T_mu, T_m, comp, occ: int, n: int, interpret: bool):
+    """Fused a·b mod m. a, b: (..., n) normalized int32 limbs. Drop-in
+    for `ops.modmul._k_mulmod` given the same context operands."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    lead = shape[:-1]
+    a2 = jnp.broadcast_to(a, shape).reshape(-1, n)
+    b2 = jnp.broadcast_to(b, shape).reshape(-1, n)
+    B = a2.shape[0]
+    tb = _pick_tile(B)
+    if tb == 0:
+        bp = _roundup(B, 8)
+        a2 = jnp.pad(a2, ((0, bp - B), (0, 0)))
+        b2 = jnp.pad(b2, ((0, bp - B), (0, 0)))
+        tb = 8
+    tmu_p, tm_p, comp_p = _consts_for(T_mu, T_m, comp, occ, n)
+    out = _mulmod_call(a2, b2, tmu_p, tm_p, comp_p, occ, n, tb, interpret)
+    return out[:B].reshape(lead + (n,))

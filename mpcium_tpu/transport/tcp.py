@@ -145,6 +145,7 @@ class BrokerServer:
         journal_fsync: bool = True,
         encrypt: bool = False,
         follow: Optional[Tuple[str, int]] = None,
+        queue_ttl_s: float = 1800.0,
     ):
         from .secure import hash_token
 
@@ -173,6 +174,18 @@ class BrokerServer:
         self._seen_ids: Dict[Tuple[str, str], float] = {}
         self._pending_q: deque = deque()  # (topic, data, deliveries, mid)
         self._pending_mids: Set[int] = set()  # mirror of _pending_q mids
+        # Work-queue TTL: per-tx/per-wallet RESULT topics mean a result
+        # published after its (sole) requester timed out and unsubscribed
+        # has no consumer, is never nak'd, and would otherwise pend — in
+        # memory, the journal, and every standby — forever. Expired
+        # messages take the dead-letter path. mid -> first-enqueue WALL
+        # time (wall, not monotonic: the stamp is journaled and
+        # replicated, so the age survives restarts and standby
+        # promotion); redeliveries keep the original stamp. A sweep
+        # thread expires the backlog even on a quiet broker with no new
+        # subscriptions to trigger a dispatch.
+        self.queue_ttl_s = queue_ttl_s
+        self._enq_ts: Dict[int, float] = {}
         self._inflight: Dict[int, Tuple[str, str, int, int, int]] = {}
         # did -> (topic, data, deliveries, cid, mid)
         self._mid_next = 1  # next mid (plain int: replication bumps it)
@@ -186,6 +199,11 @@ class BrokerServer:
             target=self._accept_loop, name="broker-accept", daemon=True
         )
         self._accept_thread.start()
+        if queue_ttl_s > 0:
+            threading.Thread(
+                target=self._ttl_sweep_loop, name="broker-ttl-sweep",
+                daemon=True,
+            ).start()
         # -- standby mode: follow a primary's queue state until it dies ----
         # (see the "High availability" section of the module docstring)
         self._follow = follow
@@ -203,7 +221,7 @@ class BrokerServer:
         compact it (pending survivors only). Enqueued-but-not-done messages
         are redelivered once a consumer subscribes — the reference's
         file-backed WorkQueue retention (message_queue.go:56-63)."""
-        pending: Dict[int, Tuple[str, str, str]] = {}
+        pending: Dict[int, Tuple[str, str, str, float]] = {}
         max_mid = 0
         if os.path.exists(path):
             with open(path) as fh:
@@ -217,7 +235,11 @@ class BrokerServer:
                         continue  # torn tail write on crash
                     if rec.get("j") == "enq":
                         pending[rec["mid"]] = (
-                            rec["topic"], rec["data"], rec.get("key", "")
+                            rec["topic"], rec["data"], rec.get("key", ""),
+                            # wall-clock enqueue stamp: the TTL age
+                            # survives restarts (pre-stamp journals age
+                            # from replay time)
+                            float(rec.get("ts", time.time())),
                         )
                         max_mid = max(max_mid, rec["mid"])
                     elif rec.get("j") == "done":
@@ -226,12 +248,13 @@ class BrokerServer:
         tmp = path + ".tmp"
         now = time.monotonic()
         with open(tmp, "w") as fh:
-            for mid, (topic, data, key) in sorted(pending.items()):
+            for mid, (topic, data, key, ts) in sorted(pending.items()):
                 fh.write(json.dumps(
                     {"j": "enq", "mid": mid, "topic": topic, "data": data,
-                     "key": key}, separators=(",", ":")) + "\n")
+                     "key": key, "ts": ts}, separators=(",", ":")) + "\n")
                 self._pending_q.append((topic, data, 0, mid))
                 self._pending_mids.add(mid)
+                self._enq_ts[mid] = ts
                 if key:
                     self._seen_ids[(topic.rsplit(".", 1)[0], key)] = now
         os.replace(tmp, path)
@@ -423,23 +446,27 @@ class BrokerServer:
             with self._lock:
                 mid = self._mid_next
                 self._mid_next += 1
+                ts = time.time()
+                self._enq_ts[mid] = ts
             # enqueues are acknowledged to publishers — fsync (when enabled)
             # so an accepted request survives a host crash, not just a
             # process crash ("done" records may be lost: redelivery of a
             # completed message is the safe direction for a work queue)
             self._journal_write(
                 {"j": "enq", "mid": mid, "topic": f["topic"],
-                 "data": f["data"], "key": key},
+                 "data": f["data"], "key": key, "ts": ts},
                 durable=True,
             )
             self._queue_dispatch(
                 f["topic"], f["data"], 0, mid,
                 rep_rec={"j": "enq", "mid": mid, "topic": f["topic"],
-                         "data": f["data"], "key": key},
+                         "data": f["data"], "key": key, "ts": ts},
             )
         elif op == "qack":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
+                if v:
+                    self._enq_ts.pop(v[4], None)
             if v:
                 self._journal_write({"j": "done", "mid": v[4]})
                 self._replicate({"j": "done", "mid": v[4]})
@@ -449,10 +476,14 @@ class BrokerServer:
             if v:
                 topic, data, deliveries, _cid, mid = v
                 if f.get("permanent"):
+                    with self._lock:
+                        self._enq_ts.pop(mid, None)
                     self._journal_write({"j": "done", "mid": mid})
                     self._replicate({"j": "done", "mid": mid})
                     return
                 if deliveries >= self.queue_config.max_deliver:
+                    with self._lock:
+                        self._enq_ts.pop(mid, None)
                     self._journal_write({"j": "done", "mid": mid})
                     self._replicate({"j": "done", "mid": mid})
                     self._dead_letter(topic, data, deliveries)
@@ -469,11 +500,14 @@ class BrokerServer:
             # undone backlog; stalling dispatch for its transmission is
             # the price of a consistent cut.
             with self._lock:
+                now = time.time()
                 snapshot = [
-                    {"j": "enq", "mid": mid, "topic": t, "data": d}
+                    {"j": "enq", "mid": mid, "topic": t, "data": d,
+                     "ts": self._enq_ts.get(mid, now)}
                     for (t, d, _dl, mid) in self._pending_q
                 ] + [
-                    {"j": "enq", "mid": v[4], "topic": v[0], "data": v[1]}
+                    {"j": "enq", "mid": v[4], "topic": v[0], "data": v[1],
+                     "ts": self._enq_ts.get(v[4], now)}
                     for v in self._inflight.values()
                 ]
                 for rec in sorted(snapshot, key=lambda r: r["mid"]):
@@ -543,6 +577,7 @@ class BrokerServer:
         if j == "enq":
             mid = rec["mid"]
             topic, data, key = rec["topic"], rec["data"], rec.get("key", "")
+            ts = float(rec.get("ts", time.time()))
             with self._lock:
                 # local mid counter must stay ahead of replicated ids so
                 # post-promotion enqueues never collide
@@ -555,13 +590,15 @@ class BrokerServer:
                     )
                 self._pending_q.append((topic, data, 0, mid))
                 self._pending_mids.add(mid)
+                self._enq_ts[mid] = ts
             self._journal_write(
                 {"j": "enq", "mid": mid, "topic": topic, "data": data,
-                 "key": key},
+                 "key": key, "ts": ts},
                 durable=True,
             )
         elif j == "done":
             with self._lock:
+                self._enq_ts.pop(rec["mid"], None)
                 if rec["mid"] in self._pending_mids:
                     self._pending_mids.discard(rec["mid"])
                     self._pending_q = deque(
@@ -625,24 +662,45 @@ class BrokerServer:
         failover despite the publisher's fsynced ack)."""
         reps: list = []
         with self._lock:
-            if rep_rec is not None:
-                reps = [c for c in self._conns.values() if c.is_replica]
-            targets = [
-                (c, sid)
-                for c in self._conns.values()
-                for sid, (kind, pat) in c.subs.items()
-                if kind == "queue" and topic_matches(pat, topic)
-            ]
-            if not targets:
-                self._pending_q.append((topic, data_hex, deliveries, mid))
-                self._pending_mids.add(mid)
-                c = None
+            # TTL check first (see _enq_ts comment in __init__): an
+            # expired message must neither enter pending/inflight nor be
+            # streamed to standbys as live — it takes the dead-letter
+            # path below. The replica list read and the pending/inflight
+            # entry stay inside this ONE critical section so a standby's
+            # snapshot cut can never fall between them.
+            ts = self._enq_ts.setdefault(mid, time.time())
+            expired = (
+                self.queue_ttl_s > 0
+                and time.time() - ts > self.queue_ttl_s
+            )
+            if expired:
+                self._enq_ts.pop(mid, None)
             else:
-                c, sid = targets[next(self._rr) % len(targets)]
-                did = next(self._did)
-                self._inflight[did] = (
-                    topic, data_hex, deliveries + 1, c.cid, mid
-                )
+                if rep_rec is not None:
+                    reps = [c for c in self._conns.values() if c.is_replica]
+                targets = [
+                    (c, sid)
+                    for c in self._conns.values()
+                    for sid, (kind, pat) in c.subs.items()
+                    if kind == "queue" and topic_matches(pat, topic)
+                ]
+                if not targets:
+                    self._pending_q.append((topic, data_hex, deliveries, mid))
+                    self._pending_mids.add(mid)
+                    c = None
+                else:
+                    c, sid = targets[next(self._rr) % len(targets)]
+                    did = next(self._did)
+                    self._inflight[did] = (
+                        topic, data_hex, deliveries + 1, c.cid, mid
+                    )
+        if expired:
+            log.warn("queue message expired (no consumer within TTL)",
+                     topic=topic, mid=mid, ttl_s=self.queue_ttl_s)
+            self._journal_write({"j": "done", "mid": mid})
+            self._replicate({"j": "done", "mid": mid})
+            self._dead_letter(topic, data_hex, deliveries)
+            return
         for r in reps:
             r.send({"op": "rep", **rep_rec})
         if c is None:
@@ -660,6 +718,38 @@ class BrokerServer:
             self._pending_mids.clear()
         for topic, data_hex, deliveries, mid in pending:
             self._queue_dispatch(topic, data_hex, deliveries, mid)
+
+    def _ttl_sweep_loop(self) -> None:
+        """Expire the pending backlog even on a quiet broker: without a
+        sweep, TTL would only be evaluated when a new subscription
+        triggers a dispatch attempt, so an orphaned result on an idle
+        broker would still pend forever."""
+        interval = max(1.0, min(self.queue_ttl_s / 4, 60.0))
+        while not self._closed:
+            time.sleep(interval)
+            if self._closed:
+                return
+            now = time.time()
+            expired = []
+            with self._lock:
+                keep: deque = deque()
+                for e in self._pending_q:
+                    ts = self._enq_ts.setdefault(e[3], now)
+                    if now - ts > self.queue_ttl_s:
+                        expired.append(e)
+                        self._pending_mids.discard(e[3])
+                        self._enq_ts.pop(e[3], None)
+                    else:
+                        keep.append(e)
+                self._pending_q = keep
+            for topic, data_hex, deliveries, mid in expired:
+                log.warn(
+                    "queue message expired (no consumer within TTL)",
+                    topic=topic, mid=mid, ttl_s=self.queue_ttl_s,
+                )
+                self._journal_write({"j": "done", "mid": mid})
+                self._replicate({"j": "done", "mid": mid})
+                self._dead_letter(topic, data_hex, deliveries)
 
     def _dead_letter(self, topic: str, data_hex: str, deliveries: int) -> None:
         with self._lock:

@@ -258,3 +258,61 @@ def test_hashed_token_config():
         t.pubsub.publish("x", b"ok")  # connection is live and authed
     finally:
         b.close()
+
+
+def test_queue_ttl_expires_orphaned_results():
+    """A message on a per-tx result topic whose sole requester is gone
+    must not pend forever: once past queue_ttl_s it takes the
+    dead-letter path on the next dispatch attempt (triggered by any new
+    subscription's pending flush) instead of accumulating in memory,
+    the journal, and every standby."""
+    b = BrokerServer(port=0, queue_ttl_s=0.3)
+    try:
+        t = tcp_transport(b.host, b.port)
+        dead = []
+        t.set_dead_letter_handler(
+            lambda topic, data, n: dead.append((topic, data))
+        )
+        # no subscriber for this per-tx topic — the requester timed out
+        # and unsubscribed before the node published the result
+        t.queues.enqueue("q.result.tx-orphan", b"late-result")
+        assert _wait(lambda: len(b._pending_q) == 1)
+        time.sleep(0.4)  # let the TTL lapse
+        # any unrelated subscription flushes pending through dispatch
+        t.queues.dequeue("q.other.*", lambda d: None)
+        assert _wait(lambda: ("q.result.tx-orphan", b"late-result") in dead)
+        assert _wait(lambda: len(b._pending_q) == 0)
+        assert not b._enq_ts
+        # a live (young) message is NOT expired by the flush
+        got = []
+        t.queues.enqueue("q.result.tx-live", b"r2")
+        t.queues.dequeue("q.result.tx-live", lambda d: got.append(d))
+        assert _wait(lambda: got == [b"r2"])
+        t.client.close()
+    finally:
+        b.close()
+
+
+def test_queue_ttl_sweep_on_idle_broker():
+    """The sweep thread must expire orphans even when NO new
+    subscription ever triggers a pending flush (quiet broker)."""
+    b = BrokerServer(port=0, queue_ttl_s=0.3)
+    b_sweep_interval_floor = 1.0  # _ttl_sweep_loop clamps to >= 1 s
+    try:
+        t = tcp_transport(b.host, b.port)
+        dead = []
+        t.set_dead_letter_handler(
+            lambda topic, data, n: dead.append((topic, data))
+        )
+        time.sleep(0.05)  # dead_sub registration in flight
+        t.queues.enqueue("q.result.tx-idle", b"late")
+        assert _wait(lambda: len(b._pending_q) == 1)
+        # no dequeue() anywhere: only the sweep can expire it
+        assert _wait(
+            lambda: ("q.result.tx-idle", b"late") in dead,
+            timeout=b_sweep_interval_floor + 2.0,
+        )
+        assert len(b._pending_q) == 0 and not b._enq_ts
+        t.client.close()
+    finally:
+        b.close()
