@@ -34,10 +34,29 @@ def _generate_peers(args) -> int:
 
 
 def _register_peers(args) -> int:
-    from mpcium_tpu.store.kvstore import FileKV
-
     with open(args.peers) as f:
         peers = json.load(f)
+    if getattr(args, "broker", ""):
+        from mpcium_tpu.store.broker_kv import BrokerKV
+        from mpcium_tpu.transport.tcp import TcpClient, parse_addrs
+
+        host, port = parse_addrs(args.broker)[0]
+        cli = TcpClient(
+            host, port,
+            auth_token=args.broker_token or None,
+            encrypt=args.broker_encrypt,
+            reconnect=False,
+        )
+        try:
+            kv = BrokerKV(cli)
+            for name, node_id in peers.items():
+                kv.put(f"mpc_peers/{name}", node_id.encode())
+        finally:
+            cli.close()
+        print(f"registered {len(peers)} peers into broker {args.broker}")
+        return 0
+    from mpcium_tpu.store.kvstore import FileKV
+
     kv = FileKV(args.registry_dir)
     for name, node_id in peers.items():
         kv.put(f"mpc_peers/{name}", node_id.encode())

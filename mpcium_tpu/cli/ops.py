@@ -25,6 +25,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     rp.add_argument("-p", "--peers", default="peers.json")
     rp.add_argument("--registry-dir", default="registry")
+    rp.add_argument(
+        "--broker", default="",
+        help="host:port — register into the broker control plane instead "
+        "of a FileKV directory (multi-host deployments; see "
+        "control_plane: broker)",
+    )
+    rp.add_argument("--broker-token", default="",
+                    help="broker auth token (with --broker)")
+    rp.add_argument("--broker-encrypt", action="store_true",
+                    help="AEAD channel to the broker (with --broker)")
 
     gi = sub.add_parser("generate-identity", help="generate a node identity")
     gi.add_argument("--node", required=True)

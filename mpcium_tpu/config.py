@@ -24,6 +24,11 @@ class AppConfig:
     identity_dir: str = "identity"
     db_dir: str = "./db"
     control_kv_dir: str = "./control"  # FileKV root (the Consul analogue)
+    # "file": FileKV directory (single-host dev; needs a shared volume for
+    # multi-process). "broker": KV served by the broker over the network —
+    # nodes share ONLY broker addresses, the multi-host deployment model
+    # (reference serves this via Consul HTTP(S), consul.go:19-47)
+    control_plane: str = "file"
     safe_prime_pool: str = ""
     passphrase: str = ""  # identity decryption (or prompt)
     broker_host: str = "127.0.0.1"  # TCP bus (the NATS analogue)

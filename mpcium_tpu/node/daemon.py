@@ -26,22 +26,23 @@ from ..utils import log
 from .node import Node
 
 
-def load_peers(cfg) -> dict:
+def load_peers(cfg, kv=None) -> dict:
     """peers.json {name: uuid} (reference generate-peers.go), else the
     control-plane ``mpc_peers/`` prefix (reference LoadPeersFromConsul,
-    main.go:302-311)."""
+    main.go:302-311) — from ``kv`` when given (broker control plane),
+    else the FileKV directory."""
     p = Path(cfg.peers_file)
     if p.exists():
         return json.loads(p.read_text())
-    kv = FileKV(cfg.control_kv_dir)
+    kv = kv if kv is not None else FileKV(cfg.control_kv_dir)
     peers = {}
     for key in kv.keys("mpc_peers/"):
         peers[key[len("mpc_peers/"):]] = (kv.get(key) or b"").decode()
     if not peers:
         raise SystemExit(
-            f"no peers: neither {cfg.peers_file} nor mpc_peers/ in "
-            f"{cfg.control_kv_dir} (run mpcium-tpu-cli generate-peers + "
-            f"register-peers first)"
+            f"no peers: neither {cfg.peers_file} nor mpc_peers/ in the "
+            f"{cfg.control_plane!r} control plane (run mpcium-tpu-cli "
+            f"generate-peers + register-peers first)"
         )
     return peers
 
@@ -63,11 +64,33 @@ def run_node(
     if decrypt_private_key and passphrase is None:
         passphrase = getpass.getpass(f"passphrase for {name} identity key: ")
 
-    peers = load_peers(cfg)
+    # transport first: with the broker control plane the SAME connection
+    # serves registry/keyinfo/peers (reference topology: NATS + Consul are
+    # two services; here the broker is the single network rendezvous)
+    from ..transport.tcp import parse_addrs
+
+    transport = tcp_transport(
+        cfg.broker_host, cfg.broker_port,
+        auth_token=cfg.broker_token or None,
+        encrypt=cfg.broker_encrypt,
+        standbys=parse_addrs(cfg.broker_standbys),
+    )
+    if cfg.control_plane == "broker":
+        from ..store.broker_kv import BrokerKV
+
+        control_kv = BrokerKV(transport.client)
+    elif cfg.control_plane == "file":
+        control_kv = FileKV(cfg.control_kv_dir)
+    else:
+        raise SystemExit(
+            f"control_plane={cfg.control_plane!r}: expected 'file' or "
+            f"'broker'"
+        )
+
+    peers = load_peers(cfg, control_kv)
     if name not in peers:
         raise SystemExit(f"node {name!r} not in peer set {sorted(peers)}")
 
-    control_kv = FileKV(cfg.control_kv_dir)
     share_store = EncryptedFileKV(Path(cfg.db_dir) / name, cfg.badger_password)
     keyinfo = KeyinfoStore(control_kv)
     identity = IdentityStore(
@@ -76,14 +99,6 @@ def run_node(
         peers,
         initiator_pubkey=bytes.fromhex(cfg.event_initiator_pubkey),
         passphrase=passphrase,
-    )
-    from ..transport.tcp import parse_addrs
-
-    transport = tcp_transport(
-        cfg.broker_host, cfg.broker_port,
-        auth_token=cfg.broker_token or None,
-        encrypt=cfg.broker_encrypt,
-        standbys=parse_addrs(cfg.broker_standbys),
     )
     registry = PeerRegistry(name, list(peers), control_kv)
     node = Node(

@@ -37,6 +37,19 @@ class PeerRegistry:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # local desired-state: heartbeats follow THIS flag, not the KV's
+        # current contents — liveness keys are transient on the broker
+        # control plane, so after a broker failover the key is absent on
+        # the standby and a KV-presence check would silently stop
+        # re-registering forever
+        self._registered = False
+        # pid -> (last heartbeat value, LOCAL monotonic time it changed):
+        # liveness is judged by whether a peer's heartbeat value keeps
+        # CHANGING, on this observer's clock — heartbeat values from
+        # other machines are never compared against the local wall clock
+        # (cross-host clock skew > the 5 s staleness budget would
+        # otherwise mark healthy peers dead forever)
+        self._hb_seen: Dict[str, tuple] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -46,16 +59,19 @@ class PeerRegistry:
         watchers treat stale entries as dead — so a SIGKILLed node that
         never ran resign() falls out of quorum instead of poisoning every
         future session (Consul achieves this with session TTLs)."""
+        self._registered = True
         self._heartbeat()
         self._poll_once()
 
     def _heartbeat(self) -> None:
-        self.kv.put(
-            READY_PREFIX + self.node_id, str(time.time()).encode()
-        )
+        # liveness entries are transient on KV backends that distinguish
+        # (BrokerKV: no journal/replication churn at 1 Hz x N nodes)
+        put = getattr(self.kv, "put_transient", self.kv.put)
+        put(READY_PREFIX + self.node_id, str(time.time()).encode())
 
     def resign(self) -> None:
         """De-register on shutdown (registry.go:198-207)."""
+        self._registered = False
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2 * self.poll_interval_s + 1)
@@ -101,17 +117,27 @@ class PeerRegistry:
 
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
-            if self.kv.get(READY_PREFIX + self.node_id) is not None:
-                self._heartbeat()  # refresh own TTL while registered
-            self._poll_once()
+            # a KV error (broker failover window on the network control
+            # plane) must not kill the watch thread: a dead loop would
+            # silently stop heartbeating forever and every peer would
+            # mark this node dead until a process restart
+            try:
+                if self._registered:
+                    self._heartbeat()  # refresh own TTL while registered
+                self._poll_once()
+            except Exception as e:  # noqa: BLE001
+                log.warn("registry poll failed; retrying",
+                         node=self.node_id, error=repr(e))
 
     def _stale_after_s(self) -> float:
         # a peer missing 5 heartbeat periods (min 3 s) is dead
         return max(5 * self.poll_interval_s, 3.0)
 
     def _poll_once(self) -> None:
-        cutoff = time.time() - self._stale_after_s()
+        stale_after = self._stale_after_s()
+        local_now = time.monotonic()
         now = set()
+        seen_pids = set()
         for k in self.kv.keys(READY_PREFIX):
             pid = k[len(READY_PREFIX):]
             if pid not in self.peer_ids:
@@ -119,12 +145,19 @@ class PeerRegistry:
             raw = self.kv.get(k)
             if raw is None:
                 continue
-            try:
-                ts = float(raw)
-            except ValueError:
-                ts = 0.0  # legacy "true" value: treat as stale-capable
-            if ts >= cutoff:
+            seen_pids.add(pid)
+            prev = self._hb_seen.get(pid)
+            if prev is None or prev[0] != raw:
+                # fresh or changed heartbeat: live, clock re-stamped on
+                # OUR monotonic clock (never the peer's wall clock)
+                self._hb_seen[pid] = (raw, local_now)
                 now.add(pid)
+            elif local_now - prev[1] <= stale_after:
+                now.add(pid)
+        # explicit resign (key deleted) forgets the peer immediately
+        for pid in list(self._hb_seen):
+            if pid not in seen_pids:
+                del self._hb_seen[pid]
         with self._lock:
             joined = now - self._ready_map
             left = self._ready_map - now

@@ -186,6 +186,16 @@ class BrokerServer:
         # subscriptions to trigger a dispatch.
         self.queue_ttl_s = queue_ttl_s
         self._enq_ts: Dict[int, float] = {}
+        # Control-plane KV served over the wire (the Consul analogue —
+        # reference pkg/infra/consul.go serves registry/keyinfo/peers over
+        # HTTP(S)+ACL; here the broker IS the network rendezvous, so the
+        # same socket carries the control plane). Durable keys are
+        # journaled (fsync'd) and replicated to standbys; transient keys
+        # (registry liveness heartbeats at 1 Hz) are neither — after a
+        # failover the nodes' heartbeat loops repopulate them within a
+        # poll period. Values are hex strings (JSON-frame safe).
+        self._kv: Dict[str, str] = {}
+        self._kv_transient: Set[str] = set()
         self._inflight: Dict[int, Tuple[str, str, int, int, int]] = {}
         # did -> (topic, data, deliveries, cid, mid)
         self._mid_next = 1  # next mid (plain int: replication bumps it)
@@ -244,6 +254,10 @@ class BrokerServer:
                         max_mid = max(max_mid, rec["mid"])
                     elif rec.get("j") == "done":
                         pending.pop(rec["mid"], None)
+                    elif rec.get("j") == "kvp":
+                        self._kv[rec["k"]] = rec["v"]
+                    elif rec.get("j") == "kvd":
+                        self._kv.pop(rec["k"], None)
         self._mid_next = max_mid + 1
         tmp = path + ".tmp"
         now = time.monotonic()
@@ -257,6 +271,10 @@ class BrokerServer:
                 self._enq_ts[mid] = ts
                 if key:
                     self._seen_ids[(topic.rsplit(".", 1)[0], key)] = now
+            for k in sorted(self._kv):
+                fh.write(json.dumps(
+                    {"j": "kvp", "k": k, "v": self._kv[k]},
+                    separators=(",", ":")) + "\n")
         os.replace(tmp, path)
 
     def _journal_write(self, rec: dict, durable: bool = False) -> None:
@@ -462,6 +480,42 @@ class BrokerServer:
                 rep_rec={"j": "enq", "mid": mid, "topic": f["topic"],
                          "data": f["data"], "key": key, "ts": ts},
             )
+        elif op == "kvput":
+            k, v = f["k"], f["v"]
+            transient = bool(f.get("t"))
+            with self._lock:
+                self._kv[k] = v
+                if transient:
+                    self._kv_transient.add(k)
+                else:
+                    self._kv_transient.discard(k)
+            if not transient:
+                self._journal_write({"j": "kvp", "k": k, "v": v},
+                                    durable=True)
+                self._replicate({"j": "kvp", "k": k, "v": v})
+            conn.send({"op": "kvr", "rid": f["rid"], "ok": True})
+        elif op == "kvget":
+            with self._lock:
+                v = self._kv.get(f["k"])
+            conn.send({"op": "kvr", "rid": f["rid"], "v": v})
+        elif op == "kvdel":
+            k = f["k"]
+            with self._lock:
+                was_transient = k in self._kv_transient
+                self._kv.pop(k, None)
+                self._kv_transient.discard(k)
+            if not was_transient:
+                # durable: a lost delete would resurrect a deliberately
+                # removed control-plane key (e.g. a revoked peer) —
+                # unlike queue "done" records, the unsafe direction
+                self._journal_write({"j": "kvd", "k": k}, durable=True)
+                self._replicate({"j": "kvd", "k": k})
+            conn.send({"op": "kvr", "rid": f["rid"], "ok": True})
+        elif op == "kvkeys":
+            p = f.get("p", "")
+            with self._lock:
+                ks = sorted(k for k in self._kv if k.startswith(p))
+            conn.send({"op": "kvr", "rid": f["rid"], "keys": ks})
         elif op == "qack":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
@@ -510,7 +564,14 @@ class BrokerServer:
                      "ts": self._enq_ts.get(v[4], now)}
                     for v in self._inflight.values()
                 ]
+                kv_snapshot = [
+                    {"j": "kvp", "k": k, "v": v}
+                    for k, v in sorted(self._kv.items())
+                    if k not in self._kv_transient
+                ]
                 for rec in sorted(snapshot, key=lambda r: r["mid"]):
+                    conn.send({"op": "rep", **rec})
+                for rec in kv_snapshot:
                     conn.send({"op": "rep", **rec})
                 conn.send({"op": "rep", "j": "synced"})
                 conn.is_replica = True
@@ -574,6 +635,14 @@ class BrokerServer:
         if j == "synced":
             self._rep_synced.set()
             return
+        # Chain replication: forward every applied record to replicas
+        # attached to THIS standby (primary <- s1 <- s2 ...), so a second
+        # standby stays current after the first one is promoted. The
+        # forward happens INSIDE the same critical section that applies
+        # the record (the RLock re-enters for _replicate) — forwarding
+        # outside it would let a downstream replica cut its snapshot
+        # between the forward and the apply and miss the record from
+        # both paths.
         if j == "enq":
             mid = rec["mid"]
             topic, data, key = rec["topic"], rec["data"], rec.get("key", "")
@@ -591,6 +660,7 @@ class BrokerServer:
                 self._pending_q.append((topic, data, 0, mid))
                 self._pending_mids.add(mid)
                 self._enq_ts[mid] = ts
+                self._replicate(rec)
             self._journal_write(
                 {"j": "enq", "mid": mid, "topic": topic, "data": data,
                  "key": key, "ts": ts},
@@ -604,7 +674,21 @@ class BrokerServer:
                     self._pending_q = deque(
                         e for e in self._pending_q if e[3] != rec["mid"]
                     )
+                self._replicate(rec)
             self._journal_write({"j": "done", "mid": rec["mid"]})
+        elif j == "kvp":
+            with self._lock:
+                self._kv[rec["k"]] = rec["v"]
+                self._replicate(rec)
+            self._journal_write({"j": "kvp", "k": rec["k"], "v": rec["v"]},
+                                durable=True)
+        elif j == "kvd":
+            with self._lock:
+                self._kv.pop(rec["k"], None)
+                self._replicate(rec)
+            # durable like kvput: resurrecting a deliberately deleted
+            # control-plane key (a removed peer) is the unsafe direction
+            self._journal_write({"j": "kvd", "k": rec["k"]}, durable=True)
 
     # -- pub/sub -------------------------------------------------------------
 
@@ -813,6 +897,8 @@ class TcpClient:
         # sid -> (kind, pattern, handler); pattern kept for failover replay
         self._handlers: Dict[int, Tuple[str, str, object]] = {}
         self._dack_events: Dict[int, Tuple[threading.Event, List[bool]]] = {}
+        # rid -> (event, response box) for synchronous KV requests
+        self._kv_events: Dict[int, Tuple[threading.Event, List[dict]]] = {}
         self._dead_handlers: List[DeadLetterHandler] = []
         self._pool = ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="tcpbus")
@@ -1000,6 +1086,11 @@ class TcpClient:
         for evt, result in list(self._dack_events.values()):
             result.append(False)
             evt.set()
+        # likewise outstanding KV requests (kv_request retries once after
+        # the reconnect)
+        for evt, box in list(self._kv_events.values()):
+            box.append({"err": "connection lost"})
+            evt.set()
         log.warn("tcp bus: broker connection lost; failing over",
                  addrs=str(self._addrs))
         # retry FOREVER (the NATS client model): a broker outage longer
@@ -1079,6 +1170,11 @@ class TcpClient:
             if ent:
                 ent[1].append(bool(f.get("ok")))
                 ent[0].set()
+        elif op == "kvr":
+            ent = self._kv_events.get(f["rid"])
+            if ent:
+                ent[1].append(f)
+                ent[0].set()
         elif op == "qmsg":
             ent = self._handlers.get(f["sid"])
 
@@ -1153,6 +1249,35 @@ class TcpClient:
     def enqueue(self, topic: str, data: bytes, idempotency_key: str = "") -> None:
         self._send({"op": "enqueue", "topic": topic, "data": data.hex(),
                     "key": idempotency_key})
+
+    def kv_request(self, frame: dict, timeout_s: float = 10.0) -> dict:
+        """Synchronous control-plane KV round-trip (kvput/kvget/kvdel/
+        kvkeys → kvr). One transparent retry after a broker failover —
+        KV ops are idempotent, and the standby carries the replicated
+        durable keys."""
+        last: Exception = TransportError("kv request not attempted")
+        for _ in range(2):
+            rid = next(self._rid)
+            evt, box = threading.Event(), []
+            self._kv_events[rid] = (evt, box)
+            try:
+                self._send({**frame, "rid": rid})
+                if not evt.wait(timeout_s):
+                    raise TransportError(
+                        f"KV request timed out: {frame.get('op')}"
+                    )
+                if box and "err" not in box[0]:
+                    return box[0]
+                last = TransportError(
+                    f"KV request failed: {box[0].get('err') if box else '?'}"
+                )
+            except TransportError as e:
+                last = e
+            finally:
+                self._kv_events.pop(rid, None)
+            # wait out the failover window before the single retry
+            self._connected.wait(timeout=timeout_s)
+        raise last
 
     def add_dead_letter_handler(self, handler: DeadLetterHandler) -> None:
         if not self._dead_handlers:

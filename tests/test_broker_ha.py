@@ -10,7 +10,8 @@ import time
 
 import pytest
 
-from mpcium_tpu.transport.tcp import BrokerServer, TcpClient, parse_addrs
+from mpcium_tpu.transport.tcp import (BrokerServer, TcpClient,
+                                      parse_addrs, tcp_transport)
 
 TOKEN = "ha-test-token"
 
@@ -157,3 +158,52 @@ def test_parse_addrs():
     assert parse_addrs(":9") == [("127.0.0.1", 9)]
     with pytest.raises(ValueError, match="host:port"):
         parse_addrs("broker-standby")  # port-less config typo
+
+
+def _wait(cond, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_two_standby_chain_failover(tmp_path):
+    """primary <- s1 <- s2 chain: records applied on s1 are forwarded to
+    s2, so after primary AND s1 die, clients still find the full durable
+    state (queues + control-plane KV) on s2."""
+    from mpcium_tpu.store.broker_kv import BrokerKV
+
+    primary = BrokerServer(port=0)
+    s1 = BrokerServer(port=0, follow=(primary.host, primary.port))
+    assert _wait(lambda: s1._rep_synced.is_set())
+    s2 = BrokerServer(port=0, follow=(s1.host, s1.port))
+    assert _wait(lambda: s2._rep_synced.is_set())
+    try:
+        t = tcp_transport(
+            primary.host, primary.port,
+            standbys=[(s1.host, s1.port), (s2.host, s2.port)],
+        )
+        kv = BrokerKV(t.client)
+        kv.put("threshold_keyinfo/w1", b"meta-1")
+        t.queues.enqueue("q.work.a", b"payload-1")
+        assert _wait(lambda: "threshold_keyinfo/w1" in s2._kv)
+        assert _wait(lambda: len(s2._pending_q) == 1)
+
+        primary.close()
+        # new writes land on s1 and must chain onward to s2
+        assert _wait(lambda: kv.get("threshold_keyinfo/w1") == b"meta-1",
+                     timeout=15.0)
+        kv.put("threshold_keyinfo/w2", b"meta-2")
+        assert _wait(lambda: "threshold_keyinfo/w2" in s2._kv)
+
+        s1.close()
+        assert _wait(lambda: kv.get("threshold_keyinfo/w2") == b"meta-2",
+                     timeout=15.0)
+        got = []
+        t.queues.dequeue("q.work.*", lambda d: got.append(d))
+        assert _wait(lambda: got == [b"payload-1"])
+        t.client.close()
+    finally:
+        s2.close()

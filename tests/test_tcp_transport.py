@@ -316,3 +316,87 @@ def test_queue_ttl_sweep_on_idle_broker():
         t.client.close()
     finally:
         b.close()
+
+
+def test_broker_kv_roundtrip_and_transient():
+    from mpcium_tpu.store.broker_kv import BrokerKV
+
+    b = BrokerServer(port=0)
+    try:
+        t = tcp_transport(b.host, b.port)
+        kv = BrokerKV(t.client)
+        assert kv.get("mpc_peers/node0") is None
+        kv.put("mpc_peers/node0", b"uuid-0")
+        kv.put("mpc_peers/node1", b"uuid-1")
+        kv.put_transient("ready/node0", b"171000")
+        assert kv.get("mpc_peers/node0") == b"uuid-0"
+        assert kv.keys("mpc_peers/") == ["mpc_peers/node0", "mpc_peers/node1"]
+        assert kv.keys("ready/") == ["ready/node0"]
+        kv.delete("mpc_peers/node1")
+        assert kv.get("mpc_peers/node1") is None
+        assert kv.keys("mpc_peers/") == ["mpc_peers/node0"]
+        # binary-safe values
+        kv.put("keyinfo/w1", bytes(range(256)))
+        assert kv.get("keyinfo/w1") == bytes(range(256))
+        t.client.close()
+    finally:
+        b.close()
+
+
+def test_broker_kv_journal_durability(tmp_path):
+    """Durable keys survive a broker restart via the journal; transient
+    (liveness) keys do not."""
+    from mpcium_tpu.store.broker_kv import BrokerKV
+
+    journal = str(tmp_path / "q.jsonl")
+    b1 = BrokerServer(port=0, journal_path=journal, journal_fsync=False)
+    t1 = tcp_transport(b1.host, b1.port)
+    kv1 = BrokerKV(t1.client)
+    kv1.put("keyinfo/w1", b"meta")
+    kv1.put("mpc_peers/node0", b"uuid-0")
+    kv1.put_transient("ready/node0", b"hb")
+    kv1.delete("mpc_peers/node0")
+    t1.client.close()
+    b1.close()
+
+    b2 = BrokerServer(port=0, journal_path=journal, journal_fsync=False)
+    try:
+        t2 = tcp_transport(b2.host, b2.port)
+        kv2 = BrokerKV(t2.client)
+        assert kv2.get("keyinfo/w1") == b"meta"
+        assert kv2.get("mpc_peers/node0") is None  # deleted before restart
+        assert kv2.keys("ready/") == []  # transient: not journaled
+        t2.client.close()
+    finally:
+        b2.close()
+
+
+def test_broker_kv_replicates_to_standby():
+    """Durable KV state reaches a hot standby (snapshot + stream) and is
+    readable after the client fails over."""
+    from mpcium_tpu.store.broker_kv import BrokerKV
+
+    primary = BrokerServer(port=0)
+    t = tcp_transport(primary.host, primary.port)
+    kv = BrokerKV(t.client)
+    kv.put("keyinfo/pre", b"in-snapshot")
+    standby = BrokerServer(port=0, follow=(primary.host, primary.port))
+    try:
+        assert _wait(lambda: standby._rep_synced.is_set())
+        assert standby._kv.get("keyinfo/pre") is not None
+        kv.put("keyinfo/live", b"streamed")
+        kv.put_transient("ready/node0", b"hb")
+        assert _wait(lambda: "keyinfo/live" in standby._kv)
+        assert "ready/node0" not in standby._kv  # transient: not streamed
+        # failover: client configured with both addresses reads from standby
+        t2 = tcp_transport(primary.host, primary.port,
+                           standbys=[(standby.host, standby.port)])
+        kv2 = BrokerKV(t2.client)
+        primary.close()
+        t.client.close()
+        assert _wait(lambda: kv2.get("keyinfo/live") == b"streamed",
+                     timeout=15.0)
+        assert kv2.get("keyinfo/pre") == b"in-snapshot"
+        t2.client.close()
+    finally:
+        standby.close()
