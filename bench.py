@@ -273,6 +273,7 @@ def main() -> None:
         "platform": platform,
         "batch": B,
         "runs": runs,
+        "mta": os.environ.get("MPCIUM_MTA", "paillier"),
         "setup_s": round(setup_s, 1),
         "compile_s": round(compile_s, 1),
         "profiled_run_s": round(profiled_s, 1),

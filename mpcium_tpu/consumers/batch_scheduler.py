@@ -9,17 +9,21 @@ by ONE protocol instance whose per-round compute is one engine dispatch
 normal result queues / reply inboxes.
 
 Batch composition must be identical on every quorum member, so one member
-is the MANIFEST LEADER — deterministically the lexicographically-smallest
-participant (static: no election, no races). The leader buffers requests
+is the MANIFEST LEADER — the lexicographically-smallest participant the
+local registry sees as LIVE (rank-based: no election protocol; the
+registry's liveness view is the election). The leader buffers requests
 for ``window_s`` (or until ``max_batch``), then broadcasts a manifest
 listing the batch, **signed with its node identity**; receivers verify
-both the leader signature and — because the leader is otherwise untrusted
-for content — every entry's ORIGINAL initiator signature. Requests stay
-buffered on EVERY member (leader included) until a manifest covers them:
-manifest arrival removes them and hands their dedup claims to the batch;
-if no manifest covers a request within ``manifest_timeout_s`` (leader
-down, manifest lost), it falls back to the per-session signing path (one
-bucket-level timer, not one per request).
+the leader signature, that the leader is a topology member, and —
+because the leader is otherwise untrusted for content — every entry's
+ORIGINAL initiator signature. Requests stay buffered on EVERY member
+(leader included) until a manifest covers them. Escalation when no
+manifest arrives (one bucket-level timer, not one per request): at
+``manifest_timeout_s`` the DEPUTY — the next-smallest live member —
+re-fires the entries under its own manifest (no throughput cliff when
+the leader dies); at twice that, surviving entries fall back to the
+per-session signing path. Registry-view skew can at worst produce two
+manifests for one request — redundant idempotent work, never a drop.
 
 Both curves batch: ed25519 via protocol.eddsa.batch_signing (3 rounds)
 and secp256k1 via protocol.ecdsa.batch_signing (distributed GG18, 9
@@ -53,6 +57,17 @@ class _Entry:
     added_at: float = field(default_factory=time.monotonic)
     fired: bool = False  # leader: already covered by a published manifest
     kind: str = "sign"
+    took_over: bool = False  # deputy already re-fired this entry once
+
+
+def _key_participants(key: Tuple) -> Tuple:
+    """The candidate-leader set encoded in a bucket key (see the three
+    submit paths for the key shapes)."""
+    if key[0] == "kg":
+        return key[1]
+    if key[0] == "rs":
+        return key[2]
+    return key[0]
 
 
 def _bucket_key(info) -> Tuple:
@@ -212,7 +227,7 @@ class BatchSigningScheduler:
                 return False  # no GG18 aux → per-session path
             extra = (dig,)
         key = _bucket_key(info) + (msg.key_type,) + extra
-        leader = sorted(info.participant_peer_ids)[0]
+        leader = self._acting_leader(info.participant_peer_ids)
         return self._buffer_entry(key, _Entry(msg, reply_topic), leader)
 
     def submit_keygen(self, msg: wire.GenerateKeyMessage) -> bool:
@@ -225,7 +240,7 @@ class BatchSigningScheduler:
         if self.node.registry.ready_count() < len(self.node.peer_ids):
             return False
         key = ("kg", tuple(self.node.peer_ids), self._threshold())
-        leader = sorted(self.node.peer_ids)[0]
+        leader = self._acting_leader(self.node.peer_ids)
         return self._buffer_entry(key, _Entry(msg, "", kind="kg"), leader)
 
     def submit_reshare(self, msg: wire.ResharingMessage) -> bool:
@@ -239,8 +254,24 @@ class BatchSigningScheduler:
             "rs", msg.key_type, tuple(info.participant_peer_ids),
             info.threshold, info.epoch, msg.new_threshold,
         )
-        leader = sorted(info.participant_peer_ids)[0]
+        leader = self._acting_leader(info.participant_peer_ids)
         return self._buffer_entry(key, _Entry(msg, "", kind="rs"), leader)
+
+    def _acting_leader(self, candidates) -> str:
+        """Manifest leadership is RANK-based, not static: the smallest
+        participant the local registry sees as live leads; if it dies,
+        the next-smallest takes over (at submit time when the registry
+        already knows, or via the fallback sweep's deputy escalation when
+        it finds out the hard way). Receivers verify manifest signatures
+        and content but accept any MEMBER as leader — rank only decides
+        who sends, so registry-view skew degrades to a redundant
+        (idempotent) batch instead of a dropped one."""
+        cand = sorted(candidates)
+        live = [
+            p for p in cand
+            if p == self.node.node_id or self.node.registry.is_peer_ready(p)
+        ]
+        return (live or cand)[0]
 
     def _buffer_entry(self, key: Tuple, entry: _Entry, leader: str) -> bool:
         """Shared intake: append to the bucket, fire/arm the leader window,
@@ -371,27 +402,58 @@ class BatchSigningScheduler:
         )
 
     def _fallback_sweep(self, key: Tuple) -> None:
-        """Follower liveness: entries the leader never covered go down the
-        per-session path; re-arm while the bucket stays non-empty."""
+        """Follower liveness, with deputy escalation: when the acting
+        leader (smallest LIVE participant) is THIS node, entries the
+        previous leader never covered are re-fired under our own manifest
+        instead of dropping to the per-session path — the static-leader
+        throughput cliff. Entries whose takeover also times out (our
+        manifest lost too) go per-session on the next sweep; re-arm while
+        the bucket stays non-empty."""
         now = time.monotonic()
         stale: List[_Entry] = []
+        takeover: List[_Entry] = []
         with self._lock:
             self._timers.pop(("fb", key), None)
             if self._closed:
                 return
             bucket = self._buckets.get(key, [])
+            # Escalation schedule: at age T the acting leader (deputy,
+            # once the registry has marked the old leader dead) re-fires
+            # the entries under its own manifest; everyone else waits 2T
+            # before the per-session path so a follower's fallback can't
+            # race the deputy's manifest. A taken-over entry's clock is
+            # reset — if the deputy's manifest is lost too, it reaches
+            # per-session one T later.
+            T = self.manifest_timeout_s
+            if self._acting_leader(
+                _key_participants(key)
+            ) == self.node.node_id:
+                takeover = [
+                    e for e in bucket
+                    if now - e.added_at >= T and not e.took_over
+                ]
+                for e in takeover:
+                    e.took_over = True
+                    e.fired = False
+                    e.added_at = now
             stale = [
                 e for e in bucket
-                if now - e.added_at >= self.manifest_timeout_s
+                if e not in takeover
+                and now - e.added_at >= (T if e.took_over else 2 * T)
             ]
             bucket[:] = [e for e in bucket if e not in stale]
             if bucket:
-                t = threading.Timer(
-                    self.manifest_timeout_s, self._fallback_sweep, (key,)
-                )
+                t = threading.Timer(T, self._fallback_sweep, (key,))
                 t.daemon = True
                 t.start()
                 self._timers[("fb", key)] = t
+        if takeover:
+            log.warn(
+                "batch leader timed out — deputy taking over manifest",
+                node=self.node.node_id, entries=len(takeover),
+                kind=takeover[0].kind,
+            )
+            self._fire(key)
         for e in stale:
             log.warn("batch manifest timeout — per-session fallback",
                      wallet=e.msg.wallet_id, kind=e.kind,
@@ -429,8 +491,9 @@ class BatchSigningScheduler:
         if not reqs:
             return
         # leader authenticity: must be signed by the node it claims to be
-        # from, and that node must be the deterministic leader for the
-        # wallets' topology (checked against OUR keyinfo below)
+        # from, and that node must be a MEMBER of the wallets' topology
+        # (checked against OUR keyinfo below; rank decides who sends, not
+        # who is accepted — deputy takeover depends on that)
         body = _manifest_body(batch_id, leader, requests, kind)
         if not self.node.identity.verify_peer(leader, body, sig):
             log.warn("batch manifest with BAD leader signature dropped",
@@ -442,9 +505,12 @@ class BatchSigningScheduler:
         if kind == "rs":
             self._on_reshare_manifest(batch_id, leader, reqs)
             return
+        # leadership is rank-based with deputy takeover (_acting_leader):
+        # any MEMBER of the wallet topology may lead; signatures and
+        # content checks below carry the trust, rank only picks the sender
         info = self.node.keyinfo.get(reqs[0][0].key_type, reqs[0][0].wallet_id)
-        if info is None or sorted(info.participant_peer_ids)[0] != leader:
-            log.warn("batch manifest from non-leader dropped",
+        if info is None or leader not in info.participant_peer_ids:
+            log.warn("batch manifest from non-member dropped",
                      batch=batch_id, claimed=leader)
             return
         # batch homogeneity: the leader is untrusted — every request must
@@ -507,8 +573,10 @@ class BatchSigningScheduler:
 
     def _on_keygen_manifest(self, batch_id: str, leader: str, reqs) -> None:
         node = self.node
-        if leader != sorted(node.peer_ids)[0]:
-            log.warn("keygen manifest from non-leader dropped",
+        # rank-based leadership with deputy takeover: any cluster member
+        # may lead (signatures + content checks carry the trust)
+        if leader not in node.peer_ids:
+            log.warn("keygen manifest from non-member dropped",
                      batch=batch_id, claimed=leader)
             return
         for msg, _r in reqs:
@@ -693,8 +761,9 @@ class BatchSigningScheduler:
         node = self.node
         first = reqs[0][0]
         info = node.keyinfo.get(first.key_type, first.wallet_id)
-        if info is None or sorted(info.participant_peer_ids)[0] != leader:
-            log.warn("reshare manifest from non-leader dropped",
+        # rank-based leadership with deputy takeover (see _acting_leader)
+        if info is None or leader not in info.participant_peer_ids:
+            log.warn("reshare manifest from non-member dropped",
                      batch=batch_id, claimed=leader)
             return
         want = (

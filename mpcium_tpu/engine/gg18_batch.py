@@ -958,7 +958,6 @@ class GG18BatchCoSigners:
         assert self.q >= first.threshold + 1, "quorum below threshold+1"
         universe_xs = party_xs(first.participants)
         quorum_xs = [universe_xs[p] for p in party_ids]
-        self.ctx = [PartyCtx(pid, preparams[pid], rng) for pid in party_ids]
         # all ordered MtA directions
         self.pairs = [
             (a, b)
@@ -966,10 +965,32 @@ class GG18BatchCoSigners:
             for b in range(self.q)
             if a != b
         ]
-        self.mta = {
-            (a, b): MtaBatch(self.ctx[a], self.ctx[b], dom)
-            for (a, b) in self.pairs
-        }
+        # MtA implementation: "paillier" (default — the GG18 MtA with
+        # range proofs) or "ot" (experimental OT-based Gilboa
+        # multiplication, protocol.ecdsa.mta_ot: no Paillier anywhere in
+        # signing, passive security — see SECURITY.md "OT-MtA")
+        self.mta_impl = os.environ.get("MPCIUM_MTA", "paillier")
+        if self.mta_impl not in ("paillier", "ot"):
+            raise ValueError(
+                f"MPCIUM_MTA={self.mta_impl!r}: expected 'paillier' or 'ot'"
+            )
+        if self.mta_impl == "ot":
+            from ..protocol.ecdsa.mta_ot import OTMtALeg
+
+            self.ctx = None
+            self.mta = None
+            self.ot_legs = {
+                (a, b): OTMtALeg(
+                    f"{party_ids[a]}->{party_ids[b]}", rng=rng
+                )
+                for (a, b) in self.pairs
+            }
+        else:
+            self.ctx = [PartyCtx(pid, preparams[pid], rng) for pid in party_ids]
+            self.mta = {
+                (a, b): MtaBatch(self.ctx[a], self.ctx[b], dom)
+                for (a, b) in self.pairs
+            }
         # additive shares w_i = λ_i·x_i mod q (λ shared across the batch)
         self.w = []
         self.W_pts = []
@@ -1051,6 +1072,30 @@ class GG18BatchCoSigners:
             Gamma_comp.append(comp)
             g_commit.append(commit)
 
+        if self.mta_impl == "ot":
+            # ---- OT path: no Paillier in signing at all. Rounds 1-3 of
+            # the MtA machinery collapse into Gilboa OT multiplication
+            # per (ordered pair, secret): alpha+beta ≡ k_a·secret_b
+            # (mod q). Commitments/Γ from round 1 above are unchanged,
+            # as is everything from δ/σ assembly on — the signature
+            # itself is still verified in-protocol at phase 5.
+            _mark("r1_commit_encrypt_rangeproof", *Gamma_comp)
+            ok = jnp.ones((B,), bool)
+            alpha_shares = {}
+            beta_shares = {}
+            for (a, b) in self.pairs:
+                leg = self.ot_legs[(a, b)]
+                for name, secret in (("gamma", gamma[b]), ("w", self.w[b])):
+                    al, be = leg.run(k[a], secret)
+                    alpha_shares[(a, b, name)] = al
+                    beta_shares[(a, b, name)] = be
+            _mark("r2_mta_ot",
+                  *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs])
+            return self._finish_sign(
+                _mark, m, ok, k, gamma, Gamma, Gamma_comp,
+                g_commit, g_blind, alpha_shares, beta_shares,
+            )
+
         # per-party encryption of k_i (one ciphertext reused by all pairs)
         c_k, u_k, k_plain = [], [], []
         for i in range(q):
@@ -1125,6 +1170,20 @@ class GG18BatchCoSigners:
                     _mod_q_from_limbs(sub["Rb"]["beta_prime"], mta.p_bp)
                 )
 
+        return self._finish_sign(
+            _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit, g_blind,
+            alpha_shares, beta_shares,
+        )
+
+    def _finish_sign(
+        self, _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,
+        g_blind, alpha_shares, beta_shares,
+    ) -> Dict[str, np.ndarray]:
+        """Shared tail of both MtA implementations: δ/σ assembly, R
+        reconstruction, Schnorr PoKs, the full phase-5 commit–reveal and
+        the in-protocol ECDSA verification."""
+        B, q = self.B, self.q
+        ring = self.ring
         delta_i, sigma_i = [], []
         for i in range(q):
             d = ring.mulmod(k[i], gamma[i])

@@ -1,0 +1,319 @@
+"""OT-based MtA: Gilboa multiplication over the secp256k1 scalar ring.
+
+The GG18 cost center is the Paillier MtA — encryptions, range proofs and
+CRT decryptions at 2048/4096-bit are ~100% of the audited mulmod budget
+(PERFORMANCE.md). This module replaces the two MtA legs with
+oblivious-transfer multiplication (Gilboa 1999, the approach of
+Doerner–Kondi–Lee–shelat threshold ECDSA): Alice holds ``a``, Bob holds
+``b``, and they derive additive shares of ``a·b mod q`` from 256
+1-of-2 OTs per product — all symmetric crypto (PRG expansion, bit-matrix
+transpose, bulk hashing) plus 256-bit scalar sums, with NO big-modulus
+exponentiation anywhere.
+
+Construction:
+
+* **Base OTs** (once per ordered quorum pair): Chou–Orlandi simplest OT
+  on secp256k1. Bob — the MtA *sender* — is the base-OT *receiver* with
+  choice bits Δ (the IKNP role reversal).
+* **Extension** (per signing batch): IKNP. Alice's choice bits are the
+  bits of her multiplicands; matrices expand from the base seeds with a
+  per-(leg, invocation) counter, so one base-OT setup serves every batch
+  (stateful IKNP: each extension consumes a disjoint PRF range).
+* **Payloads**: for OT index (s, i) — signature lane s, bit i — Bob
+  offers ``z_{s,i}`` and ``z_{s,i} + 2^i·b_s mod q``; Alice picks by bit
+  i of ``a_s``. Alice's share is ``Σ_i received``, Bob's is ``-Σ_i z``;
+  they sum to ``a_s·b_s mod q``. The mod-q sums and the ``2^i·b``
+  doubling ladder run batched on device (existing scalar-ring kernels);
+  masking/hashing runs through the native batched SHA-256.
+
+SECURITY (be explicit — this is why the flag defaults off): as
+implemented this provides passive (semi-honest) security. The IKNP
+extension lacks the KOS15 consistency check and the Gilboa payloads lack
+the DKLs18/19 encoding-and-check layer, so an ACTIVELY deviating party
+can cause incorrect outputs; incorrectness is caught by the engine's
+in-protocol ECDSA verification (no bad signature is ever released), but
+REPEATED induced aborts can leak bits of the honest party's nonce share
+(selective-failure), which the default Paillier+range-proof path
+prevents. See SECURITY.md "OT-MtA (experimental)". Enable with
+MPCIUM_MTA=ot.
+
+Reference correspondence: replaces the tss-lib MtA
+(SURVEY.md §2.3; reference pkg/mpc/ecdsa_signing_session.go drives
+Paillier MtA per session) with the OT-based alternative the DKLs line of
+work uses; the leading axis is the concurrent-session batch.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import secrets as _secrets
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...core import bignum as bn
+from ...core import hostmath as hm
+from ...core import secp256k1_jax as sp
+from ...core.bignum import P256
+
+KAPPA = 128  # IKNP width / computational security parameter
+NBITS = 256  # multiplicand bits (secp256k1 scalars)
+Q = hm.SECP_N
+
+
+def _hash_rows(prefix: bytes, rows: np.ndarray) -> np.ndarray:
+    """sha256(prefix || row) per row → (N, 32); native batched C++ when
+    built, hashlib otherwise (tests / cold environments)."""
+    from ... import native
+
+    if native.available():
+        return native.batch_sha256(prefix, np.ascontiguousarray(rows))
+    out = np.empty((rows.shape[0], 32), np.uint8)
+    for i, r in enumerate(rows):
+        out[i] = np.frombuffer(
+            hashlib.sha256(prefix + r.tobytes()).digest(), np.uint8
+        )
+    return out
+
+
+def _prg(seeds: np.ndarray, n_bytes: int, tag: bytes) -> np.ndarray:
+    """Expand each 32-byte seed row to ``n_bytes`` pseudorandom bytes:
+    sha256(tag || seed || j || blk) blocks. → (n_seeds, n_bytes)."""
+    n_seeds = seeds.shape[0]
+    nblk = -(-n_bytes // 32)
+    rows = np.empty((n_seeds * nblk, 32 + 2 + 4), np.uint8)
+    rows[:, :32] = np.repeat(seeds, nblk, axis=0)
+    j_ids = np.repeat(np.arange(n_seeds, dtype=np.uint16), nblk)
+    rows[:, 32:34] = j_ids.view(np.uint8).reshape(-1, 2)
+    blk = np.tile(np.arange(nblk, dtype=np.uint32), n_seeds)
+    rows[:, 34:38] = blk.view(np.uint8).reshape(-1, 4)
+    out = _hash_rows(b"mpcium-ot-prg|" + tag, rows)
+    return out.reshape(n_seeds, nblk * 32)[:, :n_bytes]
+
+
+# ---------------------------------------------------------------------------
+# base OTs (Chou–Orlandi on secp256k1; host curve math, once per pair)
+# ---------------------------------------------------------------------------
+
+
+def _pt_hash(point) -> bytes:
+    return hashlib.sha256(b"mpcium-ot-base|" + hm.secp_compress(point)).digest()
+
+
+def _secp_neg(pt: "hm.SecpPoint") -> "hm.SecpPoint":
+    if pt.is_infinity:
+        return pt
+    return hm.SecpPoint(pt.x, (-pt.y) % hm.SECP_P)
+
+
+def base_ot_sender_init(rng=_secrets) -> Tuple[int, bytes]:
+    """Alice (MtA receiver = base-OT sender): y, S = y·G."""
+    y = rng.randbelow(Q - 1) + 1
+    return y, hm.secp_compress(hm.secp_mul(y, hm.SECP_G))
+
+
+def base_ot_receive(
+    S_bytes: bytes, rng=_secrets
+) -> Tuple[np.ndarray, np.ndarray, List[bytes]]:
+    """Bob: picks Δ ∈ {0,1}^κ; per base OT j sends R_j = x_j·G + Δ_j·S
+    and keeps k^{Δ_j}_j = H(x_j·S). Returns (delta_bits, keys, R_msgs)."""
+    S = hm.secp_decompress(S_bytes)
+    delta = np.frombuffer(rng.token_bytes(KAPPA), np.uint8) & 1
+    keys = np.empty((KAPPA, 32), np.uint8)
+    msgs: List[bytes] = []
+    for j in range(KAPPA):
+        x = rng.randbelow(Q - 1) + 1
+        R = hm.secp_mul(x, hm.SECP_G)
+        if delta[j]:
+            R = hm.secp_add(R, S)
+        msgs.append(hm.secp_compress(R))
+        keys[j] = np.frombuffer(_pt_hash(hm.secp_mul(x, S)), np.uint8)
+    return delta, keys, msgs
+
+
+def base_ot_sender_keys(
+    y: int, R_msgs: List[bytes]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Alice: k0_j = H(y·R_j), k1_j = H(y·(R_j − S))."""
+    S = hm.secp_mul(y, hm.SECP_G)
+    k0 = np.empty((KAPPA, 32), np.uint8)
+    k1 = np.empty((KAPPA, 32), np.uint8)
+    for j, rb in enumerate(R_msgs):
+        R = hm.secp_decompress(rb)
+        k0[j] = np.frombuffer(_pt_hash(hm.secp_mul(y, R)), np.uint8)
+        k1[j] = np.frombuffer(
+            _pt_hash(hm.secp_mul(y, hm.secp_add(R, _secp_neg(S)))),
+            np.uint8,
+        )
+    return k0, k1
+
+
+# ---------------------------------------------------------------------------
+# device helpers (batched mod-q arithmetic on the scalar-ring kernels)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _pow2_ladder(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, n) scalars mod q → (NBITS, B, n) with ladder[i] = 2^i·b."""
+    ring = sp.scalar_ring()
+
+    def step(c, _):
+        return ring.addmod(c, c), c
+
+    _, ys = lax.scan(step, b, None, length=NBITS)
+    return ys
+
+
+@jax.jit
+def _m1_payloads(z_red: jnp.ndarray, pow2b: jnp.ndarray) -> jnp.ndarray:
+    """(B, NBITS, n) reduced z + (NBITS, B, n) ladder → m1 bytes
+    (B, NBITS, 32)."""
+    ring = sp.scalar_ring()
+    m1 = ring.addmod(z_red, jnp.moveaxis(pow2b, 0, 1))
+    return bn.limbs_to_bytes_le(m1, P256, 32)
+
+
+@jax.jit
+def _reduce_bytes(raw: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) LE bytes → reduced (..., n) scalars mod q."""
+    ring = sp.scalar_ring()
+    return ring.reduce(bn.bytes_to_limbs_le(raw, P256, 22))
+
+
+@jax.jit
+def _sum_mod_q(vals: jnp.ndarray) -> jnp.ndarray:
+    """(B, NBITS, n) reduced scalars → (B, n) sum mod q. Limb sums stay
+    < NBITS·2^12 < 2^21 (int32-safe redundancy), normalized by carry
+    before the Barrett reduce."""
+    ring = sp.scalar_ring()
+    s = jnp.sum(vals, axis=-2)
+    return ring.reduce(bn.carry(s, P256))
+
+
+@jax.jit
+def _neg_sum_mod_q(vals: jnp.ndarray) -> jnp.ndarray:
+    ring = sp.scalar_ring()
+    return ring.negmod(_sum_mod_q(vals))
+
+
+@jax.jit
+def _bits_256(a: jnp.ndarray) -> jnp.ndarray:
+    """(B, n) scalars → (B, NBITS) int32 bits LSB-first."""
+    return bn.limbs_to_bits(a, P256, NBITS)
+
+
+# ---------------------------------------------------------------------------
+# the per-ordered-pair MtA instance
+# ---------------------------------------------------------------------------
+
+
+def _pack(bits: np.ndarray) -> np.ndarray:
+    """(..., n) 0/1 → packed little-endian-bit bytes (..., n/8)."""
+    return np.packbits(bits.astype(np.uint8), axis=-1, bitorder="little")
+
+
+def _unpack(b: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(b, axis=-1, count=n, bitorder="little")
+
+
+class OTMtALeg:
+    """One ordered quorum pair (Alice = receiver with ``a``; Bob = sender
+    with ``b``). In-process engine form: both roles live on this object,
+    but every inter-party value flows through explicit ``*_msg`` returns
+    so the distributed wiring is mechanical. One instance serves every
+    batch invocation (extension counter in all PRF/hash domains)."""
+
+    def __init__(self, tag: str, rng=_secrets):
+        self.tag = tag.encode()
+        self.rng = rng
+        self.ctr = 0
+        y, S = base_ot_sender_init(rng)
+        self.delta, self.keysD, R_msgs = base_ot_receive(S, rng)
+        self.k0, self.k1 = base_ot_sender_keys(y, R_msgs)
+        self.delta_packed = _pack(self.delta)  # (16,)
+
+    # -- Alice ---------------------------------------------------------------
+
+    def alice_round1(self, a: jnp.ndarray, ctr: int) -> Dict:
+        """``a``: (B, n) scalars mod q. → {"U": (κ, M/8)} to Bob; local
+        state kept for round 3."""
+        B = a.shape[0]
+        M = B * NBITS
+        r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)
+        tag = self.tag + b"|%d" % ctr
+        t0 = _prg(self.k0, M // 8, tag)  # (κ, M/8) packed
+        t1 = _prg(self.k1, M // 8, tag)
+        r_packed = _pack(r_bits)
+        U = t0 ^ t1 ^ r_packed[None, :]
+        self._alice_state = (t0, r_bits, B, tag)
+        return {"U": U}
+
+    def alice_round3(self, bob_msg: Dict) -> jnp.ndarray:
+        """Recover the selected payloads → Alice's additive share
+        (B, n) mod q."""
+        t0, r_bits, B, tag = self._alice_state
+        M = B * NBITS
+        # t_i rows: transpose of the (κ, M) bit matrix
+        tmat = _unpack(t0, M)  # (κ, M) bits
+        t_rows = _pack(tmat.T)  # (M, κ/8)
+        idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
+        pads = _hash_rows(
+            b"mpcium-ot-pad|" + tag, np.concatenate([t_rows, idx], axis=1)
+        )
+        sel = np.where(
+            r_bits[:, None].astype(bool), bob_msg["y1"], bob_msg["y0"]
+        )
+        m_sel = (sel ^ pads).reshape(B, NBITS, 32)
+        return _sum_mod_q(_reduce_bytes(jnp.asarray(m_sel)))
+
+    # -- Bob -----------------------------------------------------------------
+
+    def bob_round2(
+        self, b_scalars: jnp.ndarray, alice_msg: Dict, ctr: int
+    ) -> Tuple[Dict, jnp.ndarray]:
+        """``b_scalars``: (B, n) mod q. → ({"y0", "y1"} to Alice, Bob's
+        additive share (B, n) mod q)."""
+        B = b_scalars.shape[0]
+        M = B * NBITS
+        tag = self.tag + b"|%d" % ctr
+        tD = _prg(self.keysD, M // 8, tag)  # (κ, M/8)
+        U = alice_msg["U"]
+        Qm = tD ^ (U & (self.delta[:, None].astype(np.uint8) * 0xFF))
+        q_rows = _pack(_unpack(Qm, M).T)  # (M, κ/8)
+        idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
+        pad0 = _hash_rows(
+            b"mpcium-ot-pad|" + tag, np.concatenate([q_rows, idx], axis=1)
+        )
+        pad1 = _hash_rows(
+            b"mpcium-ot-pad|" + tag,
+            np.concatenate([q_rows ^ self.delta_packed[None, :], idx], axis=1),
+        )
+        # payloads: z and z + 2^i·b (mod q), z freshly random per OT
+        z_raw = np.frombuffer(
+            self.rng.token_bytes(M * 32), np.uint8
+        ).reshape(B, NBITS, 32)
+        z_red = _reduce_bytes(jnp.asarray(z_raw))  # (B, NBITS, n)
+        m1 = np.asarray(_m1_payloads(z_red, _pow2_ladder(b_scalars)))
+        m0 = np.asarray(bn.limbs_to_bytes_le(z_red, P256, 32))
+        y0 = m0.reshape(M, 32) ^ pad0
+        y1 = m1.reshape(M, 32) ^ pad1
+        beta = _neg_sum_mod_q(z_red)
+        return {"y0": y0, "y1": y1}, beta
+
+    # -- in-process convenience (the engine path) ----------------------------
+
+    def run(
+        self, a: jnp.ndarray, b: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Both roles locally: → (alice_share, bob_share), (B, n) each,
+        with alice_share + bob_share ≡ a·b (mod q) per lane."""
+        ctr = self.ctr
+        self.ctr += 1
+        msg_a = self.alice_round1(a, ctr)
+        msg_b, beta = self.bob_round2(b, msg_a, ctr)
+        alpha = self.alice_round3(msg_b)
+        return alpha, beta

@@ -16,6 +16,13 @@ from ..utils import log
 
 READY_PREFIX = "ready/"
 DEFAULT_POLL_S = 1.0  # reference registry.go:16
+# First-sight tolerance: a key we have never observed change counts as
+# live only while its self-reported wall stamp is within this bound of
+# our clock (covers realistic cross-host skew; a SIGKILLed peer's old
+# corpse key is rejected immediately, a fresh one goes dead after one
+# staleness window because its value never changes). Ongoing liveness is
+# purely change-based and never compares clocks.
+COARSE_SKEW_S = 300.0
 
 
 class PeerRegistry:
@@ -43,12 +50,13 @@ class PeerRegistry:
         # the standby and a KV-presence check would silently stop
         # re-registering forever
         self._registered = False
-        # pid -> (last heartbeat value, LOCAL monotonic time it changed):
-        # liveness is judged by whether a peer's heartbeat value keeps
-        # CHANGING, on this observer's clock — heartbeat values from
-        # other machines are never compared against the local wall clock
-        # (cross-host clock skew > the 5 s staleness budget would
-        # otherwise mark healthy peers dead forever)
+        # pid -> (last heartbeat value, LOCAL monotonic time it changed,
+        # confirmed): liveness is judged by whether a peer's heartbeat
+        # value keeps CHANGING, on this observer's clock — remote wall
+        # clocks are never compared against ours (cross-host skew > the
+        # 5 s budget would mark healthy peers dead forever), and a key
+        # merely EXISTING proves nothing (a SIGKILLed peer's stale key
+        # persists; "confirmed" flips only once a change is observed)
         self._hb_seen: Dict[str, tuple] = {}
 
     # -- lifecycle ----------------------------------------------------------
@@ -133,26 +141,53 @@ class PeerRegistry:
         # a peer missing 5 heartbeat periods (min 3 s) is dead
         return max(5 * self.poll_interval_s, 3.0)
 
+    @staticmethod
+    def _coarse_fresh(raw: bytes) -> bool:
+        try:
+            ts = float(raw)
+        except (TypeError, ValueError):
+            return False  # legacy "true" values: must be seen to change
+        return abs(time.time() - ts) <= COARSE_SKEW_S
+
     def _poll_once(self) -> None:
         stale_after = self._stale_after_s()
         local_now = time.monotonic()
         now = set()
         seen_pids = set()
-        for k in self.kv.keys(READY_PREFIX):
+        # one network round-trip when the KV supports prefix scans
+        # (BrokerKV); keys()+get() per peer otherwise (FileKV/MemoryKV)
+        scan = getattr(self.kv, "scan", None)
+        if scan is not None:
+            entries = scan(READY_PREFIX).items()
+        else:
+            entries = [
+                (k, self.kv.get(k)) for k in self.kv.keys(READY_PREFIX)
+            ]
+        for k, raw in entries:
             pid = k[len(READY_PREFIX):]
-            if pid not in self.peer_ids:
-                continue
-            raw = self.kv.get(k)
-            if raw is None:
+            if pid not in self.peer_ids or raw is None:
                 continue
             seen_pids.add(pid)
+            if pid == self.node_id:
+                # our own registration needs no cross-checking
+                if self._registered:
+                    now.add(pid)
+                continue
             prev = self._hb_seen.get(pid)
-            if prev is None or prev[0] != raw:
-                # fresh or changed heartbeat: live, clock re-stamped on
-                # OUR monotonic clock (never the peer's wall clock)
-                self._hb_seen[pid] = (raw, local_now)
+            if prev is None:
+                # first sight: benefit of the doubt only within the
+                # coarse skew bound (see COARSE_SKEW_S); confirmation —
+                # and all ongoing liveness — comes from observing the
+                # value CHANGE on our own clock
+                self._hb_seen[pid] = (raw, local_now, False)
+                if self._coarse_fresh(raw):
+                    now.add(pid)
+            elif prev[0] != raw:
+                self._hb_seen[pid] = (raw, local_now, True)
                 now.add(pid)
-            elif local_now - prev[1] <= stale_after:
+            elif local_now - prev[1] <= stale_after and (
+                prev[2] or self._coarse_fresh(raw)
+            ):
                 now.add(pid)
         # explicit resign (key deleted) forgets the peer immediately
         for pid in list(self._hb_seen):

@@ -60,3 +60,14 @@ class BrokerKV(KVStore):
             {"op": "kvkeys", "p": prefix}, self._timeout_s
         )
         return list(r.get("keys") or [])
+
+    def scan(self, prefix: str = "") -> dict:
+        """Prefix scan in ONE round-trip: {key: value-bytes}. The
+        registry's 1 Hz liveness poll uses this instead of keys() +
+        per-key get() (O(N) network RTTs per poll otherwise)."""
+        r = self._cli.kv_request(
+            {"op": "kvscan", "p": prefix}, self._timeout_s
+        )
+        return {
+            k: bytes.fromhex(v) for k, v in (r.get("items") or {}).items()
+        }

@@ -483,16 +483,23 @@ class BrokerServer:
         elif op == "kvput":
             k, v = f["k"], f["v"]
             transient = bool(f.get("t"))
+            # journal + replicate INSIDE the lock: KV mutations of the
+            # same key are order-sensitive (unlike queue done records) —
+            # a put and a delete racing outside the lock could reach the
+            # journal/standbys in the opposite order they were applied,
+            # resurrecting a revoked key after failover. Durable KV ops
+            # are rare (peers/keyinfo writes); heartbeats are transient
+            # and skip this path, so the fsync-under-lock cost is
+            # negligible.
             with self._lock:
                 self._kv[k] = v
                 if transient:
                     self._kv_transient.add(k)
                 else:
                     self._kv_transient.discard(k)
-            if not transient:
-                self._journal_write({"j": "kvp", "k": k, "v": v},
-                                    durable=True)
-                self._replicate({"j": "kvp", "k": k, "v": v})
+                    self._journal_write({"j": "kvp", "k": k, "v": v},
+                                        durable=True)
+                    self._replicate({"j": "kvp", "k": k, "v": v})
             conn.send({"op": "kvr", "rid": f["rid"], "ok": True})
         elif op == "kvget":
             with self._lock:
@@ -504,18 +511,27 @@ class BrokerServer:
                 was_transient = k in self._kv_transient
                 self._kv.pop(k, None)
                 self._kv_transient.discard(k)
-            if not was_transient:
-                # durable: a lost delete would resurrect a deliberately
-                # removed control-plane key (e.g. a revoked peer) —
-                # unlike queue "done" records, the unsafe direction
-                self._journal_write({"j": "kvd", "k": k}, durable=True)
-                self._replicate({"j": "kvd", "k": k})
+                if not was_transient:
+                    # durable: a lost delete would resurrect a
+                    # deliberately removed control-plane key (e.g. a
+                    # revoked peer) — the unsafe direction
+                    self._journal_write({"j": "kvd", "k": k}, durable=True)
+                    self._replicate({"j": "kvd", "k": k})
             conn.send({"op": "kvr", "rid": f["rid"], "ok": True})
         elif op == "kvkeys":
             p = f.get("p", "")
             with self._lock:
                 ks = sorted(k for k in self._kv if k.startswith(p))
             conn.send({"op": "kvr", "rid": f["rid"], "keys": ks})
+        elif op == "kvscan":
+            # one-round-trip prefix scan: the registry polls liveness at
+            # 1 Hz per node; per-key gets would be O(N) RTTs per poll
+            p = f.get("p", "")
+            with self._lock:
+                items = {
+                    k: v for k, v in self._kv.items() if k.startswith(p)
+                }
+            conn.send({"op": "kvr", "rid": f["rid"], "items": items})
         elif op == "qack":
             with self._lock:
                 v = self._inflight.pop(f["did"], None)
