@@ -140,6 +140,9 @@ class BatchSigningScheduler:
         self.claim_rs = claim_rs or (lambda kt, w: True)
         self._lock = threading.RLock()
         self._buckets: Dict[Tuple, List[_Entry]] = {}
+        # dedup strings of claims inherited by RUNNING batch threads
+        # (see owns_dedup / the consumer GC's empty-claim reaping)
+        self._batch_claims: set = set()
         self._timers: Dict[Tuple, threading.Timer] = {}  # leader windows +
         # follower fallbacks, keyed ("win"|"fb", bucket)
         self._sessions: List[Session] = []
@@ -549,13 +552,42 @@ class BatchSigningScheduler:
         covered = {_entry_key("sign", m) for m, _ in reqs}
         inherited = self._inherit_covered("sign", covered)
         threading.Thread(
-            target=self._run_batch, args=(batch_id, reqs, inherited),
+            target=self._run_guarded,
+            args=("sign", self._run_batch, batch_id, reqs, inherited),
             name=f"bsign-{batch_id}", daemon=True,
         ).start()
 
+    @staticmethod
+    def _dedup_str(kind: str, ek: Tuple[str, str]) -> str:
+        """Map an _entry_key to the consumer's dedup-claim string."""
+        if kind == "kg":
+            return f"keygen-{ek[0]}"
+        if kind == "rs":
+            kt, w = ek[0].split(":", 1)
+            return f"reshare-{kt}-{w}"
+        return f"{ek[0]}-{ek[1]}"
+
+    def owns_dedup(self, dedup_key: str) -> bool:
+        """True while this scheduler is responsible for the claim — the
+        request sits in a bucket awaiting a manifest, or a running batch
+        inherited it. The consumer's GC must not reap (and error-report)
+        such claims: full-size batches legitimately outlive the session
+        timeout."""
+        with self._lock:
+            if dedup_key in self._batch_claims:
+                return True
+            for bucket in self._buckets.values():
+                for e in bucket:
+                    if self._dedup_str(
+                        e.kind, _entry_key(e.kind, e.msg)
+                    ) == dedup_key:
+                        return True
+        return False
+
     def _inherit_covered(self, kind: str, covered) -> List[Tuple[str, str]]:
         """Remove manifest-covered entries of ``kind`` from local buckets,
-        returning their claim keys (inherited by the batch)."""
+        returning their claim keys (inherited by the batch; tracked in
+        _batch_claims until the batch thread forgets them)."""
         inherited: List[Tuple[str, str]] = []
         with self._lock:
             for bucket in self._buckets.values():
@@ -567,7 +599,32 @@ class BatchSigningScheduler:
                     else:
                         kept.append(e)
                 bucket[:] = kept
+            for k in inherited:
+                self._batch_claims.add(self._dedup_str(kind, k))
         return inherited
+
+    def _forget_batch_claims(self, kind: str, inherited) -> None:
+        """Batch thread is done (success, release, or crash): the
+        consumer's GC owns any still-unreleased claims from here on."""
+        with self._lock:
+            for k in inherited:
+                self._batch_claims.discard(self._dedup_str(kind, k))
+
+    def _run_guarded(self, kind: str, runner, batch_id, reqs, *rest):
+        """Thread entry for every batch runner: registers ALL the
+        batch's request keys in _batch_claims for the run's duration
+        (conservative — claims held by live per-session runs have
+        tracked sessions and never consult owns_dedup), and guarantees
+        they are forgotten even if the runner crashes, so a dead batch's
+        claims age into the consumer GC instead of black-holing."""
+        keys = [_entry_key(kind, m) for m, _r in reqs]
+        with self._lock:
+            for k in keys:
+                self._batch_claims.add(self._dedup_str(kind, k))
+        try:
+            runner(batch_id, reqs, *rest)
+        finally:
+            self._forget_batch_claims(kind, keys)
 
     # -- batched DKG (kind == "kg") ------------------------------------------
 
@@ -587,7 +644,9 @@ class BatchSigningScheduler:
         covered = {_entry_key("kg", m) for m, _ in reqs}
         inherited = self._inherit_covered("kg", covered)
         threading.Thread(
-            target=self._run_keygen_batch, args=(batch_id, reqs, inherited),
+            target=self._run_guarded,
+            args=("kg", self._run_keygen_batch, batch_id, reqs,
+                  inherited),
             name=f"bdkg-{batch_id}", daemon=True,
         ).start()
 
@@ -787,8 +846,9 @@ class BatchSigningScheduler:
         covered = {_entry_key("rs", m) for m, _ in reqs}
         inherited = self._inherit_covered("rs", covered)
         threading.Thread(
-            target=self._run_reshare_batch,
-            args=(batch_id, reqs, info, inherited),
+            target=self._run_guarded,
+            args=("rs", self._run_reshare_batch, batch_id, reqs, info,
+                  inherited),
             name=f"brs-{batch_id}", daemon=True,
         ).start()
 

@@ -48,6 +48,8 @@ class EventConsumer:
         self.session_timeout_s = session_timeout_s
         self.gc_interval_s = gc_interval_s
         self._sessions: Dict[str, list] = {}  # dedup key -> [Session]
+        self._claim_ts: Dict[str, float] = {}  # dedup key -> claim time
+        self._claim_meta: Dict[str, tuple] = {}  # ("sign", msg) for GC
         self._lock = threading.RLock()
         self._subs = []
         self._gc_stop = threading.Event()
@@ -228,8 +230,18 @@ class EventConsumer:
                      wallet=msg.wallet_id, tx=msg.tx_id)
             return
         dedup = f"{msg.wallet_id}-{msg.tx_id}"
-        if not self._claim(dedup):
+        if not self._claim(dedup, meta=("sign", msg)):
             log.info("duplicate signing session ignored", key=dedup)
+            # Answer the (fresh) reply inbox anyway: a batched dispatch
+            # can legitimately outlive the durable bridge's reply window
+            # (full-size GG18 compiles take minutes), and an unanswered
+            # redelivery would march to dead-letter and emit a timeout
+            # ERROR for work that is still in flight. A reply means
+            # "accepted, in progress" — completion reaches the client
+            # through the idempotent result queues, and in-node liveness
+            # is the scheduler's/session-GC's job, not redelivery's.
+            if reply_topic:
+                self.transport.pubsub.publish(reply_topic, b"WIP")
             return
         # TPU batch path: coalesce concurrent requests into one engine
         # dispatch per round (consumers.batch_scheduler); falls back to the
@@ -427,11 +439,14 @@ class EventConsumer:
 
     # -- session bookkeeping (event_consumer.go:49-53, 550-573) -------------
 
-    def _claim(self, key: str) -> bool:
+    def _claim(self, key: str, meta=None) -> bool:
         with self._lock:
             if key in self._sessions:
                 return False
             self._sessions[key] = []
+            self._claim_ts[key] = time.monotonic()
+            if meta is not None:
+                self._claim_meta[key] = meta
             return True
 
     def _track(self, key: str, sessions) -> None:
@@ -441,10 +456,14 @@ class EventConsumer:
     def _release(self, key: str) -> None:
         with self._lock:
             self._sessions.pop(key, None)
+            self._claim_ts.pop(key, None)
+            self._claim_meta.pop(key, None)
 
     def _finish(self, key: str) -> None:
         with self._lock:
             sessions = self._sessions.pop(key, [])
+            self._claim_ts.pop(key, None)
+            self._claim_meta.pop(key, None)
         for s in sessions:
             s.close()
 
@@ -461,13 +480,54 @@ class EventConsumer:
             stale = []
             with self._lock:
                 for key, sessions in list(self._sessions.items()):
-                    if any(
-                        now - s.last_activity > self.session_timeout_s
-                        for s in sessions
-                    ):
-                        stale.append(key)
+                    if sessions:
+                        reap = any(
+                            now - s.last_activity > self.session_timeout_s
+                            for s in sessions
+                        )
+                    else:
+                        # session-less claim (scheduler-owned or the
+                        # _claim→_track window): reap only when it has
+                        # aged out AND the scheduler disowns it — an
+                        # unreaped empty claim would answer WIP to every
+                        # redelivery forever (a silent black hole), but
+                        # a live full-size batch legitimately outlives
+                        # session_timeout_s
+                        age = now - self._claim_ts.get(key, now)
+                        reap = age > self.session_timeout_s and not (
+                            self.scheduler is not None
+                            and self.scheduler.owns_dedup(key)
+                        )
+                    if reap:
+                        stale.append((key, self._claim_meta.get(key)))
                         for s in sessions:
                             s.close()
                         del self._sessions[key]
-            for key in stale:
-                log.warn("stale session reaped", key=key, node=self.node.node_id)
+                        self._claim_ts.pop(key, None)
+                        self._claim_meta.pop(key, None)
+            for key, meta in stale:
+                log.warn("stale session reaped", key=key,
+                         node=self.node.node_id)
+                # a reaped SIGNING claim must surface to the client: WIP
+                # replies have been acking its redeliveries, so without
+                # this terminal event the dead-letter path never fires
+                # and the client hangs forever
+                if meta is not None and meta[0] == "sign":
+                    msg = meta[1]
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_ERROR,
+                        wallet_id=msg.wallet_id,
+                        tx_id=msg.tx_id,
+                        network_internal_code=msg.network_internal_code,
+                        error_reason="signing session reaped after "
+                        "inactivity timeout",
+                        is_timeout=True,
+                    )
+                    try:
+                        self.transport.queues.enqueue(
+                            f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
+                            wire.canonical_json(ev.to_json()),
+                            idempotency_key=msg.tx_id,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        log.warn("reap result emit failed", error=repr(e))

@@ -3,7 +3,14 @@
 Consumes the durable signing-request queue, re-publishes each event on the
 ephemeral ``mpc:sign`` topic with a fresh reply inbox, and waits for a
 reply: reply ⇒ ack; timeout ⇒ raise (nak → queue redelivery, up to
-max_deliver, then dead-letter → timeout consumer)."""
+max_deliver, then dead-letter → timeout consumer).
+
+A reply means "accepted and in progress", not "complete": consumers
+answer OK/ERR on terminal outcomes and WIP when a redelivered request is
+already claimed by a live session or batch (batched full-size GG18 runs
+far outlive the reply window; an unanswered redelivery would dead-letter
+work still in flight). Results always travel the idempotent result
+queues, never the inbox."""
 from __future__ import annotations
 
 import threading

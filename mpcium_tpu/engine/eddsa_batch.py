@@ -39,6 +39,43 @@ from ..core.bignum import P256 as PROF
 # within BarrettCtx.reduce's 2n = 44-limb bound.
 _WIDE_LIMBS = 43
 
+# Session-axis sharding (engine/sharded.py arms this): when a mesh is
+# armed, every batch tensor entering the engine is placed with its
+# leading (session) axis partitioned over the local devices, and GSPMD
+# partitions every downstream dispatch — a multi-device host then runs
+# each party-round across all its chips with no kernel changes
+# (SURVEY.md §2.2 dimension 2). None ⇒ plain single-device placement.
+_SESSION_SHARDING = None
+
+
+def arm_session_sharding(sharding) -> None:
+    """Install (or clear, with None) the NamedSharding applied by
+    :func:`to_dev`. Called by engine.sharded.arm_session_axis()."""
+    global _SESSION_SHARDING
+    _SESSION_SHARDING = sharding
+
+
+def to_dev(x, axis: int = 0) -> jnp.ndarray:
+    """Engine ingress: jnp.asarray plus the armed session sharding on
+    ``axis`` — callers MUST name the axis that is the session batch
+    (round tensors like (q, B, 32) are party-leading: sharding axis 0
+    there would partition the committee, forcing cross-device gathers in
+    the aggregations). Axes that don't divide the mesh fall back to
+    default placement rather than failing the dispatch."""
+    arr = jnp.asarray(x)
+    s = _SESSION_SHARDING
+    if s is None or arr.ndim <= axis:
+        return arr
+    n = s.mesh.devices.size
+    if arr.shape[axis] % n != 0:
+        return arr
+    if axis == 0:
+        return jax.device_put(arr, s)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = PartitionSpec(*([None] * axis + list(s.spec)))
+    return jax.device_put(arr, NamedSharding(s.mesh, spec))
+
 
 def _reduce_wide(b64: jnp.ndarray) -> jnp.ndarray:
     """(…, 64) uint8 little-endian → canonical scalar limbs mod l."""

@@ -36,6 +36,29 @@ COMMITTEE = "committee"
 SESSIONS = "sessions"
 
 
+def arm_session_axis(n_devices: Optional[int] = None) -> Optional[Mesh]:
+    """Production wiring of the session axis (SURVEY.md §2.2 dim 2):
+    when this host has more than one accelerator, install a 1×N
+    (committee=1 — trust domains stay on separate hosts) mesh whose
+    SESSIONS axis partitions every batch tensor entering the EdDSA
+    engine; GSPMD then splits each party-round dispatch across all local
+    devices with no kernel or scheduler changes (the consumers' batched
+    parties go through `eddsa_batch.to_dev`). Returns the mesh, or None
+    on a single-device host (no-op). The daemon calls this at startup."""
+    import jax as _jax
+    from jax.sharding import NamedSharding
+
+    from . import eddsa_batch as eb
+
+    n = len(_jax.devices()) if n_devices is None else n_devices
+    if n <= 1:
+        eb.arm_session_sharding(None)
+        return None
+    mesh = make_mesh(n, committee=1)
+    eb.arm_session_sharding(NamedSharding(mesh, P(SESSIONS)))
+    return mesh
+
+
 def make_mesh(n_devices: Optional[int] = None, committee: Optional[int] = None) -> Mesh:
     """Mesh over (committee, sessions). Committee axis defaults to 2 when it
     divides the device count (parties on distinct device rows), else 1

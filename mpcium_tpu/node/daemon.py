@@ -111,6 +111,20 @@ def run_node(
         registry=registry,
         safe_prime_pool=cfg.safe_prime_pool or None,
     )
+    # multi-device hosts shard the session axis of batched dispatches
+    # over every local chip (engine/sharded.py; no-op on one device)
+    try:
+        import jax as _jax
+
+        from ..engine.sharded import arm_session_axis
+
+        mesh = arm_session_axis()
+        if mesh is not None:
+            log.info("session axis sharded over local devices",
+                     devices=len(_jax.devices()))
+    except Exception as e:  # noqa: BLE001 — never block startup on this
+        log.warn("session-axis sharding unavailable", error=repr(e))
+
     consumer = EventConsumer(
         node, transport,
         batch_signing=cfg.batch_signing,

