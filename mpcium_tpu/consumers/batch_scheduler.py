@@ -140,9 +140,17 @@ class BatchSigningScheduler:
         self.claim_rs = claim_rs or (lambda kt, w: True)
         self._lock = threading.RLock()
         self._buckets: Dict[Tuple, List[_Entry]] = {}
-        # dedup strings of claims inherited by RUNNING batch threads
-        # (see owns_dedup / the consumer GC's empty-claim reaping)
-        self._batch_claims: set = set()
+        # dedup strings of claims owned by RUNNING batch threads, as a
+        # REFCOUNT (see owns_dedup / the consumer GC's empty-claim
+        # reaping): deputy takeover plus a late original-leader manifest
+        # can legitimately run two batch threads covering one request on
+        # one node, and the second thread's exit must not clobber the
+        # first's claim protection
+        self._batch_claims: Dict[str, int] = {}
+        # session_id -> dedup strings owned by a LIVE async batch session
+        # (sign/reshare runners hand off to a Session and return; the
+        # claims stay owned until that session's _prune)
+        self._live_claims: Dict[str, set] = {}
         self._timers: Dict[Tuple, threading.Timer] = {}  # leader windows +
         # follower fallbacks, keyed ("win"|"fb", bucket)
         self._sessions: List[Session] = []
@@ -574,8 +582,11 @@ class BatchSigningScheduler:
         such claims: full-size batches legitimately outlive the session
         timeout."""
         with self._lock:
-            if dedup_key in self._batch_claims:
+            if self._batch_claims.get(dedup_key, 0) > 0:
                 return True
+            for claims in self._live_claims.values():
+                if dedup_key in claims:
+                    return True
             for bucket in self._buckets.values():
                 for e in bucket:
                     if self._dedup_str(
@@ -608,7 +619,12 @@ class BatchSigningScheduler:
         consumer's GC owns any still-unreleased claims from here on."""
         with self._lock:
             for k in inherited:
-                self._batch_claims.discard(self._dedup_str(kind, k))
+                d = self._dedup_str(kind, k)
+                n = self._batch_claims.get(d, 0) - 1
+                if n > 0:
+                    self._batch_claims[d] = n
+                else:
+                    self._batch_claims.pop(d, None)
 
     def _run_guarded(self, kind: str, runner, batch_id, reqs, *rest):
         """Thread entry for every batch runner: registers ALL the
@@ -620,9 +636,20 @@ class BatchSigningScheduler:
         keys = [_entry_key(kind, m) for m, _r in reqs]
         with self._lock:
             for k in keys:
-                self._batch_claims.add(self._dedup_str(kind, k))
+                d = self._dedup_str(kind, k)
+                self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
         try:
             runner(batch_id, reqs, *rest)
+        except BaseException:
+            # runner died before (or during) the session handoff: purge
+            # THIS batch's _live_claims registration (session ids embed
+            # the batch id — another concurrent batch covering the same
+            # requests must keep its own protection)
+            with self._lock:
+                for sid in list(self._live_claims):
+                    if sid.endswith(batch_id):
+                        del self._live_claims[sid]
+            raise
         finally:
             self._forget_batch_claims(kind, keys)
 
@@ -992,6 +1019,7 @@ class BatchSigningScheduler:
             with self._lock:
                 if session in self._sessions:
                     self._sessions.remove(session)
+                self._live_claims.pop(f"brs:{kt}:{batch_id}", None)
             session.close()
 
         session = Session(
@@ -1014,6 +1042,10 @@ class BatchSigningScheduler:
                     self.on_rs_released(kt, w[0].split(":", 1)[1])
                 return
             self._sessions.append(session)
+            # async handoff: the session owns the claims until _prune
+            self._live_claims[f"brs:{kt}:{batch_id}"] = {
+                self._dedup_str("rs", k) for k in owned
+            }
             self.batches_run += 1
         session.listen()
 
@@ -1151,6 +1183,7 @@ class BatchSigningScheduler:
             with self._lock:
                 if session in self._sessions:
                     self._sessions.remove(session)
+                self._live_claims.pop(f"bsign:{batch_id}", None)
             session.close()
 
         session = Session(
@@ -1172,5 +1205,10 @@ class BatchSigningScheduler:
                 release_all()
                 return
             self._sessions.append(session)
+            # the session now owns the claims (this runner RETURNS while
+            # the rounds run for up to an hour); _prune hands them back
+            self._live_claims[f"bsign:{batch_id}"] = {
+                self._dedup_str("sign", k) for k in owned
+            }
             self.batches_run += 1
         session.listen()

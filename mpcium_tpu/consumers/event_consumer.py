@@ -478,6 +478,26 @@ class EventConsumer:
         while not self._gc_stop.wait(self.gc_interval_s):
             now = time.monotonic()
             stale = []
+            # session-less claims (scheduler-owned or the _claim→_track
+            # window) reap only when aged out AND the scheduler disowns
+            # them — an unreaped empty claim would answer WIP to every
+            # redelivery forever, but a live full-size batch
+            # legitimately outlives session_timeout_s. The scheduler
+            # query happens OUTSIDE our lock: scheduler paths call our
+            # release callbacks while holding THEIR lock, so querying
+            # owns_dedup under ours would be an ABBA deadlock.
+            with self._lock:
+                aged_empty = [
+                    key for key, sessions in self._sessions.items()
+                    if not sessions
+                    and now - self._claim_ts.get(key, now)
+                    > self.session_timeout_s
+                ]
+            disowned = {
+                key for key in aged_empty
+                if not (self.scheduler is not None
+                        and self.scheduler.owns_dedup(key))
+            }
             with self._lock:
                 for key, sessions in list(self._sessions.items()):
                     if sessions:
@@ -486,18 +506,9 @@ class EventConsumer:
                             for s in sessions
                         )
                     else:
-                        # session-less claim (scheduler-owned or the
-                        # _claim→_track window): reap only when it has
-                        # aged out AND the scheduler disowns it — an
-                        # unreaped empty claim would answer WIP to every
-                        # redelivery forever (a silent black hole), but
-                        # a live full-size batch legitimately outlives
-                        # session_timeout_s
-                        age = now - self._claim_ts.get(key, now)
-                        reap = age > self.session_timeout_s and not (
-                            self.scheduler is not None
-                            and self.scheduler.owns_dedup(key)
-                        )
+                        # re-check under the lock: the claim must still
+                        # be present, session-less, and disowned
+                        reap = key in disowned
                     if reap:
                         stale.append((key, self._claim_meta.get(key)))
                         for s in sessions:

@@ -39,6 +39,15 @@ class SigningConsumer:
             self._sub.unsubscribe()
 
     def _handle(self, data: bytes) -> None:
+        """One delivery: publish on mpc:sign with a fresh inbox, wait one
+        reply window. Any reply acks the durable message — including WIP
+        from a claim holder still batching (terminal results travel the
+        idempotent result queues, and an in-process failure later is
+        surfaced by the consumer GC's reap-with-error). Known tradeoff:
+        if the claim-holding PROCESS dies after a WIP ack, the request is
+        gone from the queue and the client learns via its own timeout
+        rather than an explicit event — the bound is the client timeout,
+        same as the reference's initiator-side budget."""
         reply_topic = f"_inbox.{uuid.uuid4().hex}"
         got_reply = threading.Event()
         sub = self.transport.pubsub.subscribe(

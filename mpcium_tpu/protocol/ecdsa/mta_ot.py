@@ -109,6 +109,38 @@ def _secp_neg(pt: "hm.SecpPoint") -> "hm.SecpPoint":
     return hm.SecpPoint(pt.x, (-pt.y) % hm.SECP_P)
 
 
+def _bcast_pt(pt_bytes: bytes, n: int):
+    """Compressed point → device SecpPointJ broadcast to batch n."""
+    p = sp.from_host([hm.secp_decompress(pt_bytes)])
+    return type(p)(
+        *(jnp.broadcast_to(c, (n,) + c.shape[1:]) for c in p)
+    )
+
+
+@jax.jit
+def _k_base_receive(bits, delta, S_pt):
+    """Receiver's batched curve work: (compress(R), compress(X·S)).
+    Jitted once per process — the 256-step ladders would otherwise
+    re-trace per call (~minutes per quorum pair on a 1-core host)."""
+    XG = sp.base_mul(bits)
+    XS = sp.scalar_mul(bits, S_pt)
+    R = sp.select(delta, sp.add(XG, S_pt), XG)
+    return sp.compress(R), sp.compress(XS)
+
+
+@jax.jit
+def _k_base_sender(y_bits, R_pt, yS_neg_pt):
+    """Sender's batched curve work: (compress(y·R), compress(y·R−y·S))."""
+    yR = sp.scalar_mul(y_bits, R_pt)
+    return sp.compress(yR), sp.compress(sp.add(yR, yS_neg_pt))
+
+
+def _pt_hash_rows(comp_rows: np.ndarray) -> np.ndarray:
+    """(n, 33) compressed points → (n, 32) H(point) key rows (same
+    domain tag as _pt_hash)."""
+    return _hash_rows(b"mpcium-ot-base|", comp_rows)
+
+
 def base_ot_sender_init(rng=_secrets) -> Tuple[int, bytes]:
     """Alice (MtA receiver = base-OT sender): y, S = y·G."""
     y = rng.randbelow(Q - 1) + 1
@@ -119,35 +151,38 @@ def base_ot_receive(
     S_bytes: bytes, rng=_secrets
 ) -> Tuple[np.ndarray, np.ndarray, List[bytes]]:
     """Bob: picks Δ ∈ {0,1}^κ; per base OT j sends R_j = x_j·G + Δ_j·S
-    and keeps k^{Δ_j}_j = H(x_j·S). Returns (delta_bits, keys, R_msgs)."""
-    S = hm.secp_decompress(S_bytes)
+    and keeps k^{Δ_j}_j = H(x_j·S). Returns (delta_bits, keys, R_msgs).
+    All κ curve ops ride ONE batched device dispatch each (host
+    double-and-add at ~70 ms/mul would cost ~30 s per quorum pair)."""
     delta = np.frombuffer(rng.token_bytes(KAPPA), np.uint8) & 1
-    keys = np.empty((KAPPA, 32), np.uint8)
-    msgs: List[bytes] = []
-    for j in range(KAPPA):
-        x = rng.randbelow(Q - 1) + 1
-        R = hm.secp_mul(x, hm.SECP_G)
-        if delta[j]:
-            R = hm.secp_add(R, S)
-        msgs.append(hm.secp_compress(R))
-        keys[j] = np.frombuffer(_pt_hash(hm.secp_mul(x, S)), np.uint8)
+    xs = [rng.randbelow(Q - 1) + 1 for _ in range(KAPPA)]
+    bits = jnp.asarray(sp.scalars_to_bits(xs))
+    R_comp, XS_comp = _k_base_receive(
+        bits, jnp.asarray(delta), _bcast_pt(S_bytes, KAPPA)
+    )
+    msgs = [bytes(r) for r in np.asarray(R_comp)]
+    keys = _pt_hash_rows(np.asarray(XS_comp))
     return delta, keys, msgs
 
 
 def base_ot_sender_keys(
     y: int, R_msgs: List[bytes]
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Alice: k0_j = H(y·R_j), k1_j = H(y·(R_j − S))."""
+    """Alice: k0_j = H(y·R_j), k1_j = H(y·(R_j − S)) — batched device
+    scalar-mults (y broadcast across the κ rows)."""
     S = hm.secp_mul(y, hm.SECP_G)
-    k0 = np.empty((KAPPA, 32), np.uint8)
-    k1 = np.empty((KAPPA, 32), np.uint8)
-    for j, rb in enumerate(R_msgs):
-        R = hm.secp_decompress(rb)
-        k0[j] = np.frombuffer(_pt_hash(hm.secp_mul(y, R)), np.uint8)
-        k1[j] = np.frombuffer(
-            _pt_hash(hm.secp_mul(y, hm.secp_add(R, _secp_neg(S)))),
-            np.uint8,
-        )
+    # y·(R − S) = y·R − y·S — subtract the SCALED point, not S itself
+    yS_neg = hm.secp_mul(y, S)
+    yS_neg = hm.SecpPoint(yS_neg.x, (-yS_neg.y) % hm.SECP_P)
+    R = sp.from_host([hm.secp_decompress(rb) for rb in R_msgs])
+    y_bits = jnp.broadcast_to(
+        jnp.asarray(sp.scalars_to_bits([y])), (KAPPA, 256)
+    )
+    yR_comp, yRmS_comp = _k_base_sender(
+        y_bits, R, _bcast_pt(hm.secp_compress(yS_neg), KAPPA)
+    )
+    k0 = _pt_hash_rows(np.asarray(yR_comp))
+    k1 = _pt_hash_rows(np.asarray(yRmS_comp))
     return k0, k1
 
 
