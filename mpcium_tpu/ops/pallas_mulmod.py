@@ -89,7 +89,7 @@ def _carry_int(v: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mulmod_kernel(
-    x_ref, y_ref, tmu_ref, tm_ref, comp_ref, out_ref, *, occ: int,
+    x_ref, y2_ref, tmu_ref, tm_ref, comp_ref, out_ref, *, occ: int,
     n_pad: int, frame: int, l1: int
 ):
     tb = x_ref.shape[0]
@@ -99,12 +99,16 @@ def _mulmod_kernel(
     xf = jnp.pad(
         x_ref[:].astype(f32), ((0, 0), (0, frame - n_pad))
     )  # (tb, frame)
-    nq = -(-occ // 8)  # 8·nq ≤ n_pad (x/y zero above occ)
+    nq = y2_ref.shape[0]  # ceil(occ/8); y zero above occ
 
+    # y arrives pre-arranged as (nq, tb, 8): Mosaic only allows dynamic
+    # lane-dim offsets it can prove 128-aligned, so the q-loop indexes
+    # the LEADING dim (dynamic ok) and the 8 per-phase scalars are
+    # static lane slices broadcast along the frame.
     def q_body(q, st):
         xc = st[0]
         ss = list(st[1:])
-        yq = y_ref[:, pl.ds(8 * q, 8)].astype(f32)  # (tb, 8)
+        yq = y2_ref[q].astype(f32)  # (tb, 8)
         for r in range(8):
             ss[r] = ss[r] + xc * yq[:, r:r + 1]
         return (_shift_up(xc, 8),) + tuple(ss)
@@ -178,7 +182,11 @@ def _mulmod_call(
     frame = _roundup(max(2 * n, 2 * occ + 16), 128)
     l1 = 2 * n - occ + 1
     xp = jnp.pad(x, ((0, 0), (0, n_pad - n)))
-    yp = jnp.pad(y, ((0, 0), (0, n_pad - n)))
+    # pre-arrange y as (nq, B, 8): y2[q, b, r] = y[b, 8q+r] (see kernel)
+    nq = -(-occ // 8)
+    ypad = max(0, 8 * nq - n)
+    y2 = jnp.pad(y, ((0, 0), (0, ypad)))[:, :8 * nq]
+    y2 = y2.reshape(b, nq, 8).transpose(1, 0, 2)
     kernel = functools.partial(
         _mulmod_kernel, occ=occ, n_pad=n_pad, frame=frame, l1=l1
     )
@@ -189,7 +197,7 @@ def _mulmod_call(
         in_specs=[
             pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
+            pl.BlockSpec((nq, tb, 8), lambda i: (0, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(tmu_p.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -201,7 +209,7 @@ def _mulmod_call(
         out_specs=pl.BlockSpec((tb, n_pad), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(xp, yp, tmu_p, tm_p, comp_p)
+    )(xp, y2, tmu_p, tm_p, comp_p)
     return out[:, :n]
 
 
