@@ -611,20 +611,26 @@ class BatchSigningScheduler:
                         kept.append(e)
                 bucket[:] = kept
             for k in inherited:
-                self._batch_claims.add(self._dedup_str(kind, k))
+                d = self._dedup_str(kind, k)
+                self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
         return inherited
+
+    def _forget_locked(self, kind: str, keys) -> None:
+        """Decrement (and drop at zero) the refcounts for ``keys``.
+        Caller holds self._lock."""
+        for k in keys:
+            d = self._dedup_str(kind, k)
+            n = self._batch_claims.get(d, 0) - 1
+            if n > 0:
+                self._batch_claims[d] = n
+            else:
+                self._batch_claims.pop(d, None)
 
     def _forget_batch_claims(self, kind: str, inherited) -> None:
         """Batch thread is done (success, release, or crash): the
         consumer's GC owns any still-unreleased claims from here on."""
         with self._lock:
-            for k in inherited:
-                d = self._dedup_str(kind, k)
-                n = self._batch_claims.get(d, 0) - 1
-                if n > 0:
-                    self._batch_claims[d] = n
-                else:
-                    self._batch_claims.pop(d, None)
+            self._forget_locked(kind, inherited)
 
     def _run_guarded(self, kind: str, runner, batch_id, reqs, *rest):
         """Thread entry for every batch runner: registers ALL the
@@ -632,12 +638,26 @@ class BatchSigningScheduler:
         (conservative — claims held by live per-session runs have
         tracked sessions and never consult owns_dedup), and guarantees
         they are forgotten even if the runner crashes, so a dead batch's
-        claims age into the consumer GC instead of black-holing."""
+        claims age into the consumer GC instead of black-holing.
+
+        ``rest`` is forwarded to the runner verbatim and MUST end with
+        the batch's inherited claim keys (every runner takes them as its
+        last parameter): their inherit-phase holds transfer to this
+        registration — register first, then release, under one lock, so
+        the count never touches zero and the GC can't reap in between."""
         keys = [_entry_key(kind, m) for m, _r in reqs]
+        *_, inherited = rest
+        for k in inherited:
+            if not (isinstance(k, tuple) and len(k) == 2):
+                raise TypeError(
+                    f"_run_guarded: rest must end with inherited claim "
+                    f"keys, got {k!r}"
+                )
         with self._lock:
             for k in keys:
                 d = self._dedup_str(kind, k)
                 self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
+            self._forget_locked(kind, inherited)
         try:
             runner(batch_id, reqs, *rest)
         except BaseException:

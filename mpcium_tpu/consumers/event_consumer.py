@@ -507,8 +507,16 @@ class EventConsumer:
                         )
                     else:
                         # re-check under the lock: the claim must still
-                        # be present, session-less, and disowned
-                        reap = key in disowned
+                        # be present, session-less, disowned, AND still
+                        # aged — during the out-of-lock owns_dedup query
+                        # the claim may have been released and freshly
+                        # re-claimed by a redelivery; its new _claim_ts
+                        # fails the age test and spares it
+                        reap = (
+                            key in disowned
+                            and now - self._claim_ts.get(key, now)
+                            > self.session_timeout_s
+                        )
                     if reap:
                         stale.append((key, self._claim_meta.get(key)))
                         for s in sessions:

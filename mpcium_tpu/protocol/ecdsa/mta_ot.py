@@ -172,8 +172,7 @@ def base_ot_sender_keys(
     scalar-mults (y broadcast across the κ rows)."""
     S = hm.secp_mul(y, hm.SECP_G)
     # y·(R − S) = y·R − y·S — subtract the SCALED point, not S itself
-    yS_neg = hm.secp_mul(y, S)
-    yS_neg = hm.SecpPoint(yS_neg.x, (-yS_neg.y) % hm.SECP_P)
+    yS_neg = _secp_neg(hm.secp_mul(y, S))
     R = sp.from_host([hm.secp_decompress(rb) for rb in R_msgs])
     y_bits = jnp.broadcast_to(
         jnp.asarray(sp.scalars_to_bits([y])), (KAPPA, 256)

@@ -1,0 +1,121 @@
+"""Claim-refcount lifecycle of the batch scheduler (no cluster, no
+engine): manifest coverage must transfer bucket entries' dedup claims to
+the batch thread with no window where ``owns_dedup`` goes false, and the
+counts must return to zero when the thread exits — success or crash.
+Regression for the set→refcount migration (a live ``.add`` on the dict
+crashed every covering manifest, and inherit+register double-counted)."""
+import threading
+import types
+
+import pytest
+
+from mpcium_tpu.consumers.batch_scheduler import (
+    BatchSigningScheduler,
+    _Entry,
+    _entry_key,
+)
+from mpcium_tpu.transport.loopback import LoopbackFabric
+
+
+class _Msg:
+    def __init__(self, wallet_id, tx_id):
+        self.wallet_id = wallet_id
+        self.tx_id = tx_id
+
+
+def _sched():
+    node = types.SimpleNamespace(node_id="n0", peer_ids=["n0", "n1", "n2"])
+    return BatchSigningScheduler(node, transport=LoopbackFabric().transport())
+
+
+def _bucket_with(s, msgs):
+    entries = [_Entry(m, f"reply.{m.tx_id}", kind="sign") for m in msgs]
+    with s._lock:
+        s._buckets[("sign-bucket",)] = list(entries)
+    return entries
+
+
+def test_inherit_transfers_claims_without_gap():
+    s = _sched()
+    msgs = [_Msg("w1", "t1"), _Msg("w2", "t2")]
+    _bucket_with(s, msgs)
+    covered = {_entry_key("sign", m) for m in msgs}
+
+    inherited = s._inherit_covered("sign", covered)
+    assert sorted(inherited) == sorted(covered)
+    assert s._buckets[("sign-bucket",)] == []
+    # between manifest processing and the batch thread's start the
+    # claims must already be protected (the GC probes owns_dedup)
+    assert s.owns_dedup("w1-t1") and s.owns_dedup("w2-t2")
+
+    seen_inside = {}
+
+    def runner(batch_id, reqs, inh):
+        seen_inside["w1"] = s.owns_dedup("w1-t1")
+        seen_inside["w2"] = s.owns_dedup("w2-t2")
+
+    reqs = [(m, f"reply.{m.tx_id}") for m in msgs]
+    s._run_guarded("sign", runner, "b1", reqs, inherited)
+    assert seen_inside == {"w1": True, "w2": True}
+    # no refcount leak: the GC owns the claims from here on
+    assert not s.owns_dedup("w1-t1") and not s.owns_dedup("w2-t2")
+    assert s._batch_claims == {}
+
+
+def test_crashing_runner_still_releases_claims():
+    s = _sched()
+    msgs = [_Msg("w3", "t3")]
+    _bucket_with(s, msgs)
+    inherited = s._inherit_covered(
+        "sign", {_entry_key("sign", m) for m in msgs}
+    )
+
+    def runner(batch_id, reqs, inh):
+        raise RuntimeError("engine died")
+
+    with pytest.raises(RuntimeError):
+        s._run_guarded(
+            "sign", runner, "b2", [(msgs[0], "r")], inherited
+        )
+    assert s._batch_claims == {}
+    assert not s.owns_dedup("w3-t3")
+
+
+def test_double_coverage_refcounts_overlap():
+    # deputy takeover + a late original-leader manifest: two batch
+    # threads legitimately cover the same request on one node; the
+    # first thread's exit must not clobber the second's protection
+    s = _sched()
+    m = _Msg("w4", "t4")
+    key = _entry_key("sign", m)
+    _bucket_with(s, [m])
+    inherited = s._inherit_covered("sign", {key})
+    barrier = threading.Barrier(2)
+    release_a = threading.Event()
+
+    def runner_a(batch_id, reqs, inh):
+        barrier.wait(timeout=5)
+        release_a.wait(timeout=5)
+
+    def runner_b(batch_id, reqs, inh):
+        barrier.wait(timeout=5)  # both threads registered
+        release_a.set()
+
+    ta = threading.Thread(
+        target=s._run_guarded,
+        args=("sign", runner_a, "ba", [(m, "r")], inherited),
+    )
+    # runner_b path: second manifest arrives with the entry no longer
+    # in a bucket -> no inherit, plain registration
+    tb = threading.Thread(
+        target=s._run_guarded,
+        args=("sign", runner_b, "bb", [(m, "r")], []),
+    )
+    ta.start()
+    tb.start()
+    ta.join(timeout=10)
+    # thread A exited while B may still run; wait B out
+    tb.join(timeout=10)
+    assert not ta.is_alive() and not tb.is_alive()
+    assert s._batch_claims == {}
+    assert not s.owns_dedup("w4-t4")
