@@ -316,6 +316,38 @@ def main() -> None:
             _STATE["record"] = dict(record)
             _emit(record)
 
+    # OT-MtA variant (MPCIUM_MTA=ot; SECURITY.md "OT-MtA"): measured as
+    # a LABELED extra when the main run used the default Paillier MtA —
+    # the honest flagship keeps tss-lib security parity, but the
+    # variant's number belongs in the driver artifact too.
+    if (platform == "tpu"
+            and os.environ.get("MPCIUM_MTA", "paillier") == "paillier"
+            and not os.environ.get("MPCIUM_BENCH_NO_OT")):
+        _STATE["stage"] = "ot_variant"
+        try:
+            # MPCIUM_MTA is read per-instance in GG18BatchCoSigners
+            # (gg18_batch.py), so flipping the env and constructing a
+            # second signer is sufficient — no re-import involved
+            os.environ["MPCIUM_MTA"] = "ot"
+            signer_ot = gb.GG18BatchCoSigners(
+                party_ids[:2], shares[:2], preparams, rng=secrets
+            )
+            out = signer_ot.sign(digests)  # warmup/compile
+            assert out["ok"].all()
+            t0 = time.perf_counter()
+            out = signer_ot.sign(digests)
+            assert out["ok"].all()
+            record["gg18_ot_mta_sigs_per_sec"] = round(
+                B / (time.perf_counter() - t0), 3
+            )
+            record["gg18_ot_mta_batch"] = B
+        except Exception as e:  # noqa: BLE001
+            record["gg18_ot_mta_error"] = repr(e)
+        finally:
+            os.environ["MPCIUM_MTA"] = "paillier"
+        _STATE["record"] = dict(record)
+        _emit(record)
+
 
 def _secondary_metrics(B: int) -> dict:
     """BASELINE configs 2/4/5: ed25519 signing, batched DKG, batched
@@ -353,6 +385,7 @@ def _secondary_metrics(B: int) -> dict:
     out["secp256k1_dkg_wallets_per_sec"] = round(
         B / (time.perf_counter() - t0), 1
     )
+    out["dkg_batch"] = B
 
     Br = max(B // 4, 1)
     rs = BatchedReshare(
@@ -366,6 +399,7 @@ def _secondary_metrics(B: int) -> dict:
     out["reshare_2of3_to_3of5_wallets_per_sec"] = round(
         Br / (time.perf_counter() - t0), 1
     )
+    out["reshare_batch"] = Br
     return out
 
 

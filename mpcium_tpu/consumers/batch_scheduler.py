@@ -561,7 +561,8 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("sign", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("sign", self._run_batch, batch_id, reqs, inherited),
+            args=("sign", self._run_batch, batch_id, reqs),
+            kwargs={"inherited": inherited},
             name=f"bsign-{batch_id}", daemon=True,
         ).start()
 
@@ -632,7 +633,8 @@ class BatchSigningScheduler:
         with self._lock:
             self._forget_locked(kind, inherited)
 
-    def _run_guarded(self, kind: str, runner, batch_id, reqs, *rest):
+    def _run_guarded(self, kind: str, runner, batch_id, reqs, *mid,
+                     inherited):
         """Thread entry for every batch runner: registers ALL the
         batch's request keys in _batch_claims for the run's duration
         (conservative — claims held by live per-session runs have
@@ -640,26 +642,20 @@ class BatchSigningScheduler:
         they are forgotten even if the runner crashes, so a dead batch's
         claims age into the consumer GC instead of black-holing.
 
-        ``rest`` is forwarded to the runner verbatim and MUST end with
-        the batch's inherited claim keys (every runner takes them as its
-        last parameter): their inherit-phase holds transfer to this
-        registration — register first, then release, under one lock, so
-        the count never touches zero and the GC can't reap in between."""
+        ``inherited`` is keyword-only (misrouting it would leak the
+        inherit-phase refcounts forever): the covered entries' holds
+        from _inherit_covered transfer to this registration — register
+        first, then release, under one lock, so the count never touches
+        zero and the GC can't reap in between. The runner receives it
+        as its final positional argument after ``mid``."""
         keys = [_entry_key(kind, m) for m, _r in reqs]
-        *_, inherited = rest
-        for k in inherited:
-            if not (isinstance(k, tuple) and len(k) == 2):
-                raise TypeError(
-                    f"_run_guarded: rest must end with inherited claim "
-                    f"keys, got {k!r}"
-                )
         with self._lock:
             for k in keys:
                 d = self._dedup_str(kind, k)
                 self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
             self._forget_locked(kind, inherited)
         try:
-            runner(batch_id, reqs, *rest)
+            runner(batch_id, reqs, *mid, inherited)
         except BaseException:
             # runner died before (or during) the session handoff: purge
             # THIS batch's _live_claims registration (session ids embed
@@ -692,8 +688,8 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("kg", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("kg", self._run_keygen_batch, batch_id, reqs,
-                  inherited),
+            args=("kg", self._run_keygen_batch, batch_id, reqs),
+            kwargs={"inherited": inherited},
             name=f"bdkg-{batch_id}", daemon=True,
         ).start()
 
@@ -894,8 +890,8 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("rs", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("rs", self._run_reshare_batch, batch_id, reqs, info,
-                  inherited),
+            args=("rs", self._run_reshare_batch, batch_id, reqs, info),
+            kwargs={"inherited": inherited},
             name=f"brs-{batch_id}", daemon=True,
         ).start()
 

@@ -55,7 +55,7 @@ def test_inherit_transfers_claims_without_gap():
         seen_inside["w2"] = s.owns_dedup("w2-t2")
 
     reqs = [(m, f"reply.{m.tx_id}") for m in msgs]
-    s._run_guarded("sign", runner, "b1", reqs, inherited)
+    s._run_guarded("sign", runner, "b1", reqs, inherited=inherited)
     assert seen_inside == {"w1": True, "w2": True}
     # no refcount leak: the GC owns the claims from here on
     assert not s.owns_dedup("w1-t1") and not s.owns_dedup("w2-t2")
@@ -75,7 +75,7 @@ def test_crashing_runner_still_releases_claims():
 
     with pytest.raises(RuntimeError):
         s._run_guarded(
-            "sign", runner, "b2", [(msgs[0], "r")], inherited
+            "sign", runner, "b2", [(msgs[0], "r")], inherited=inherited
         )
     assert s._batch_claims == {}
     assert not s.owns_dedup("w3-t3")
@@ -103,13 +103,15 @@ def test_double_coverage_refcounts_overlap():
 
     ta = threading.Thread(
         target=s._run_guarded,
-        args=("sign", runner_a, "ba", [(m, "r")], inherited),
+        args=("sign", runner_a, "ba", [(m, "r")]),
+        kwargs={"inherited": inherited},
     )
     # runner_b path: second manifest arrives with the entry no longer
     # in a bucket -> no inherit, plain registration
     tb = threading.Thread(
         target=s._run_guarded,
-        args=("sign", runner_b, "bb", [(m, "r")], []),
+        args=("sign", runner_b, "bb", [(m, "r")]),
+        kwargs={"inherited": []},
     )
     ta.start()
     tb.start()
