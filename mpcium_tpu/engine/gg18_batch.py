@@ -1085,8 +1085,10 @@ class GG18BatchCoSigners:
             beta_shares = {}
             for (a, b) in self.pairs:
                 leg = self.ot_legs[(a, b)]
-                for name, secret in (("gamma", gamma[b]), ("w", self.w[b])):
-                    al, be = leg.run(k[a], secret)
+                # one extension serves BOTH products (same k_a choice
+                # bits; set-separated pad domains — mta_ot.run_multi)
+                shares = leg.run_multi(k[a], (gamma[b], self.w[b]))
+                for name, (al, be) in zip(("gamma", "w"), shares):
                     alpha_shares[(a, b, name)] = al
                     beta_shares[(a, b, name)] = be
             _mark("r2_mta_ot",

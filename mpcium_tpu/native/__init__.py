@@ -47,6 +47,15 @@ def _build() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
+        # newer entry points bind best-effort: a stale .so missing one
+        # must not disable the whole module (batch_sha256 carried
+        # rounds of production use before ot_transpose existed)
+        if hasattr(lib, "ot_transpose"):
+            lib.ot_transpose.restype = None
+            lib.ot_transpose.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
         return lib
     except Exception:  # noqa: BLE001 — no toolchain / build failure
         return None
@@ -82,6 +91,26 @@ def batch_sha256(prefix: bytes, rows: np.ndarray) -> np.ndarray:
         out[i] = np.frombuffer(
             hashlib.sha256(prefix + rows[i].tobytes()).digest(), dtype=np.uint8
         )
+    return out
+
+
+def ot_transpose(packed: np.ndarray):
+    """Packed bit-matrix transpose (see batch_hash.cpp). ``packed``:
+    (kappa, m/8) uint8, numpy little-bitorder packing along the last
+    axis → (m, kappa/8) re-packed column rows. None when the native
+    library (or this entry point) is unavailable — caller falls back to
+    the numpy unpack/T/pack path."""
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "ot_transpose"):
+        return None
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    kappa = packed.shape[0]  # matrix rows == trow bits
+    m = packed.shape[1] * 8
+    out = np.empty((m, kappa // 8), dtype=np.uint8)
+    lib.ot_transpose(
+        packed.ctypes.data_as(ctypes.c_void_p), kappa, m,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
     return out
 
 

@@ -217,4 +217,33 @@ void batch_sha512(const uint8_t* prefix, size_t prefix_len,
   });
 }
 
+// Packed bit-matrix transpose (the OT-MtA host hot path). `packed` is
+// the (kappa, m/8) extension matrix with numpy little-bitorder packing:
+// bit j of row r is (packed[r][j>>3]>>(j&7))&1. Row j of `out` is the
+// kappa column bits re-packed LE into kappa/8 bytes -- the per-OT
+// "t row" whose prefixed hash makes the pad. The python equivalent
+// materializes the unpacked (kappa, m) byte matrix plus a
+// cache-hostile strided transpose copy (~130 MB per leg at m = 2^20);
+// this walks the packed matrix directly and writes m*kappa/8 bytes
+// once. Row hashing (with per-payload-set prefixes) rides
+// batch_sha256, so a multi-set extension pays the transpose exactly
+// once however many pad domains it derives.
+void ot_transpose(const uint8_t* packed, size_t kappa, size_t m,
+                  uint8_t* out) {
+  const size_t kb = kappa / 8;
+  const size_t mb = (m + 7) / 8;
+  parallel_rows(m, [=](size_t j) {
+    uint8_t* trow = out + j * kb;
+    const size_t jb = j >> 3;
+    const int js = int(j & 7);
+    for (size_t t = 0; t < kb; ++t) {
+      uint8_t byte = 0;
+      const uint8_t* col = packed + (8 * t) * mb + jb;
+      for (int s = 0; s < 8; ++s)
+        byte |= uint8_t((col[size_t(s) * mb] >> js) & 1) << s;
+      trow[t] = byte;
+    }
+  });
+}
+
 }  // extern "C"

@@ -254,6 +254,37 @@ def _unpack(b: np.ndarray, n: int) -> np.ndarray:
     return np.unpackbits(b, axis=-1, count=n, bitorder="little")
 
 
+def _derive_pads_multi(prefixes, packed: np.ndarray, M: int, delta=None):
+    """Per-OT hash pads from the packed (κ, M/8) extension matrix, for
+    SEVERAL payload-set hash domains at once:
+    pad_s[j] = H(prefix_s ‖ column j re-packed ‖ le32(j)), plus the
+    delta-offset variant per set when ``delta`` (packed κ/8) is given.
+    The transpose depends only on ``packed``, so it runs ONCE however
+    many sets are derived — natively (batch_hash.cpp walks the packed
+    matrix directly) when available; the numpy fallback materializes
+    the unpacked bit matrix and a strided transpose copy (~130 MB per
+    leg at M = 2^20), also once. Returns [pad0_s] or [(pad0_s, pad1_s)]
+    in prefix order."""
+    from ... import native
+
+    rows = native.ot_transpose(packed) if native.available() else None
+    if rows is None:
+        rows = _pack(_unpack(packed, M).T)  # (M, κ/8)
+    idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
+    buf = np.concatenate([rows, idx], axis=1)
+    bufd = (
+        None if delta is None
+        else np.concatenate([rows ^ delta[None, :], idx], axis=1)
+    )
+    out = []
+    for prefix in prefixes:
+        if delta is None:
+            out.append(_hash_rows(prefix, buf))
+        else:
+            out.append((_hash_rows(prefix, buf), _hash_rows(prefix, bufd)))
+    return out
+
+
 class OTMtALeg:
     """One ordered quorum pair (Alice = receiver with ``a``; Bob = sender
     with ``b``). In-process engine form: both roles live on this object,
@@ -289,20 +320,28 @@ class OTMtALeg:
     def alice_round3(self, bob_msg: Dict) -> jnp.ndarray:
         """Recover the selected payloads → Alice's additive share
         (B, n) mod q."""
+        return self.alice_round3_multi((bob_msg,))[0]
+
+    def alice_round3_multi(self, bob_msgs) -> List[jnp.ndarray]:
+        """One extension, several payload sets (see bob_round2_multi):
+        per-set pads come from the SAME transposed rows under
+        set-separated hash domains, so each set's pads are independent
+        random-oracle outputs."""
         t0, r_bits, B, tag = self._alice_state
         M = B * NBITS
-        # t_i rows: transpose of the (κ, M) bit matrix
-        tmat = _unpack(t0, M)  # (κ, M) bits
-        t_rows = _pack(tmat.T)  # (M, κ/8)
-        idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
-        pads = _hash_rows(
-            b"mpcium-ot-pad|" + tag, np.concatenate([t_rows, idx], axis=1)
+        pad_sets = _derive_pads_multi(
+            [b"mpcium-ot-pad|" + tag + b"|s%d" % s
+             for s in range(len(bob_msgs))],
+            t0, M,
         )
-        sel = np.where(
-            r_bits[:, None].astype(bool), bob_msg["y1"], bob_msg["y0"]
-        )
-        m_sel = (sel ^ pads).reshape(B, NBITS, 32)
-        return _sum_mod_q(_reduce_bytes(jnp.asarray(m_sel)))
+        alphas = []
+        for bob_msg, pads in zip(bob_msgs, pad_sets):
+            sel = np.where(
+                r_bits[:, None].astype(bool), bob_msg["y1"], bob_msg["y0"]
+            )
+            m_sel = (sel ^ pads).reshape(B, NBITS, 32)
+            alphas.append(_sum_mod_q(_reduce_bytes(jnp.asarray(m_sel))))
+        return alphas
 
     # -- Bob -----------------------------------------------------------------
 
@@ -311,32 +350,44 @@ class OTMtALeg:
     ) -> Tuple[Dict, jnp.ndarray]:
         """``b_scalars``: (B, n) mod q. → ({"y0", "y1"} to Alice, Bob's
         additive share (B, n) mod q)."""
-        B = b_scalars.shape[0]
+        msgs, betas = self.bob_round2_multi((b_scalars,), alice_msg, ctr)
+        return msgs[0], betas[0]
+
+    def bob_round2_multi(
+        self, b_list, alice_msg: Dict, ctr: int
+    ) -> Tuple[List[Dict], List[jnp.ndarray]]:
+        """Several payload sets against ONE extension. Alice's choice
+        bits (bits of ``a``) are shared across sets by construction —
+        GG18 multiplies the same k_a against both γ_b and w_b — so the
+        expensive extension half (t/U PRG expansion, the Q matrix) runs
+        once and only the per-set payload masking repeats, under
+        set-separated pad domains (`…|s0`, `…|s1`: independent RO
+        outputs from the same rows)."""
+        B = b_list[0].shape[0]
         M = B * NBITS
         tag = self.tag + b"|%d" % ctr
         tD = _prg(self.keysD, M // 8, tag)  # (κ, M/8)
         U = alice_msg["U"]
         Qm = tD ^ (U & (self.delta[:, None].astype(np.uint8) * 0xFF))
-        q_rows = _pack(_unpack(Qm, M).T)  # (M, κ/8)
-        idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
-        pad0 = _hash_rows(
-            b"mpcium-ot-pad|" + tag, np.concatenate([q_rows, idx], axis=1)
+        pad_sets = _derive_pads_multi(
+            [b"mpcium-ot-pad|" + tag + b"|s%d" % s
+             for s in range(len(b_list))],
+            Qm, M, delta=self.delta_packed,
         )
-        pad1 = _hash_rows(
-            b"mpcium-ot-pad|" + tag,
-            np.concatenate([q_rows ^ self.delta_packed[None, :], idx], axis=1),
-        )
-        # payloads: z and z + 2^i·b (mod q), z freshly random per OT
-        z_raw = np.frombuffer(
-            self.rng.token_bytes(M * 32), np.uint8
-        ).reshape(B, NBITS, 32)
-        z_red = _reduce_bytes(jnp.asarray(z_raw))  # (B, NBITS, n)
-        m1 = np.asarray(_m1_payloads(z_red, _pow2_ladder(b_scalars)))
-        m0 = np.asarray(bn.limbs_to_bytes_le(z_red, P256, 32))
-        y0 = m0.reshape(M, 32) ^ pad0
-        y1 = m1.reshape(M, 32) ^ pad1
-        beta = _neg_sum_mod_q(z_red)
-        return {"y0": y0, "y1": y1}, beta
+        msgs, betas = [], []
+        for (b_scalars, (pad0, pad1)) in zip(b_list, pad_sets):
+            # payloads: z and z + 2^i·b (mod q), z freshly random per OT
+            z_raw = np.frombuffer(
+                self.rng.token_bytes(M * 32), np.uint8
+            ).reshape(B, NBITS, 32)
+            z_red = _reduce_bytes(jnp.asarray(z_raw))  # (B, NBITS, n)
+            m1 = np.asarray(_m1_payloads(z_red, _pow2_ladder(b_scalars)))
+            m0 = np.asarray(bn.limbs_to_bytes_le(z_red, P256, 32))
+            y0 = m0.reshape(M, 32) ^ pad0
+            y1 = m1.reshape(M, 32) ^ pad1
+            msgs.append({"y0": y0, "y1": y1})
+            betas.append(_neg_sum_mod_q(z_red))
+        return msgs, betas
 
     # -- in-process convenience (the engine path) ----------------------------
 
@@ -345,9 +396,16 @@ class OTMtALeg:
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Both roles locally: → (alice_share, bob_share), (B, n) each,
         with alice_share + bob_share ≡ a·b (mod q) per lane."""
+        (pair,) = self.run_multi(a, (b,))
+        return pair
+
+    def run_multi(self, a: jnp.ndarray, b_list):
+        """Both roles locally, several Bob scalars against one ``a``
+        (ONE extension): → [(alpha_s, beta_s)] with
+        alpha_s + beta_s ≡ a·b_s (mod q) per lane."""
         ctr = self.ctr
         self.ctr += 1
         msg_a = self.alice_round1(a, ctr)
-        msg_b, beta = self.bob_round2(b, msg_a, ctr)
-        alpha = self.alice_round3(msg_b)
-        return alpha, beta
+        msgs_b, betas = self.bob_round2_multi(b_list, msg_a, ctr)
+        alphas = self.alice_round3_multi(msgs_b)
+        return list(zip(alphas, betas))

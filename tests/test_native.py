@@ -38,3 +38,14 @@ def test_large_batch_parallel_path():
     got = native.batch_sha256(b"", rows)
     i = 777
     assert got[i].tobytes() == hashlib.sha256(rows[i].tobytes()).digest()
+
+
+def test_ot_transpose_matches_numpy():
+    """Native packed bit-matrix transpose vs numpy unpack/T/pack."""
+    rng = np.random.default_rng(9)
+    for M in (256, 1024):
+        packed = rng.integers(0, 256, size=(128, M // 8), dtype=np.uint8)
+        bits = np.unpackbits(packed, axis=-1, count=M, bitorder="little")
+        want = np.packbits(bits.T, axis=-1, bitorder="little")  # (M, 16)
+        got = native.ot_transpose(packed)
+        assert got is not None and (got == want).all()
