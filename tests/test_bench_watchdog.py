@@ -1,0 +1,74 @@
+"""The bench process-watchdog child (bench._CHILD_SRC): the GIL-immune
+backstop that makes BENCH_r{N}.json un-killable. Three behaviors, each a
+real subprocess:
+
+  * sentinel written  → child stands down silently (and cleans up)
+  * parent exits      → child exits silently (a fabricated success line
+                        would mask a crash; holding the inherited stdout
+                        would block a driver reading to EOF)
+  * parent alive+silent past deadline → child emits the fallback record
+
+No jax involved — this is pure process machinery."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-second sleeps, subprocesses
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402
+
+
+def _spawn_child(tmp_path, deadline, ppid, record=None):
+    sentinel = str(tmp_path / "sentinel")
+    env = dict(os.environ)
+    env["MPCIUM_BENCH_FALLBACK"] = json.dumps(
+        record or {"metric": "m", "value": 1.25}
+    )
+    env["PYTHONPATH"] = ""
+    p = subprocess.Popen(
+        [sys.executable, "-c", bench._CHILD_SRC,
+         str(deadline), sentinel, str(ppid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    return p, sentinel
+
+
+def test_child_stands_down_on_sentinel(tmp_path):
+    p, sentinel = _spawn_child(tmp_path, deadline=60, ppid=os.getpid())
+    with open(sentinel, "w") as f:
+        f.write("1")
+    out, _ = p.communicate(timeout=30)
+    assert out == ""  # no fabricated line
+    assert p.returncode == 0
+    assert not os.path.exists(sentinel)  # cleaned up for PID reuse
+
+
+def test_child_exits_silently_when_parent_dies(tmp_path):
+    # a short-lived stand-in parent that is already gone
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=30)
+    p, _ = _spawn_child(tmp_path, deadline=60, ppid=dead.pid)
+    out, _ = p.communicate(timeout=30)
+    assert out == ""
+    assert p.returncode == 0
+
+
+def test_child_emits_fallback_for_frozen_parent(tmp_path):
+    # "frozen parent": this test process stays alive and never writes
+    # the sentinel; a short deadline makes the child emit
+    rec = {"metric": "secp256k1_2of3_gg18_sigs_per_sec", "value": 4.5}
+    p, _ = _spawn_child(tmp_path, deadline=6, ppid=os.getpid(), record=rec)
+    out, _ = p.communicate(timeout=60)
+    assert p.returncode == 0
+    line = json.loads(out.strip())
+    assert line["value"] == 4.5
+    assert line["watchdog_timeout"] is True
+    assert line["watchdog"] == "process"
