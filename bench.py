@@ -35,6 +35,11 @@ MPCIUM_BENCH_RUNS (timed runs, default 1), MPCIUM_BENCH_NO_SECONDARY=1 /
 MPCIUM_BENCH_SECONDARY=1 (secondary metrics off/on override),
 MPCIUM_BENCH_NO_OT=1 (skip the OT-MtA variant's extra compile+sign pass
 on TPU), MPCIUM_BENCH_WATCHDOG_S (watchdog deadline, 0 disables).
+The OT variant also honors MPCIUM_OT_CHUNKS (pipeline chunking,
+0/unset = auto) and MPCIUM_NATIVE_THREADS (host hash/transpose/PRG
+thread count); its host-vs-device overlap lands in the bench JSON as
+gg18_ot_mta_host_s / gg18_ot_mta_device_s / gg18_ot_mta_overlap_ratio.
+The host-only extension-stage microbench is scripts/bench_ot_host.py.
 """
 from __future__ import annotations
 
@@ -187,18 +192,13 @@ def _arm_watchdog(platform: str) -> None:
             "watchdog_s": deadline,
             "stage_reached": _STATE["stage"],
         }
-        # loaded at FIRE time, not arm time, so age_hours is current
+        # loaded at FIRE time, not arm time, so age_hours is current.
+        # The live "value" stays 0.0 — a watchdog line is NOT a
+        # measurement, and a driver parsing only metric/value must not
+        # take a stale number as this run's result; the cached record
+        # rides along under last_tpu_measurement only.
         fallback = _load_last_tpu_record()
-        if fallback and "value" in fallback:
-            # A stale real measurement beats a zero: report IT as the
-            # value, clearly labeled as cached.
-            rec.update(
-                value=fallback["value"],
-                vs_baseline=fallback.get("vs_baseline", 0.0),
-                from_cached_tpu_measurement=True,
-                last_tpu_measurement=fallback,
-            )
-        elif fallback and fallback.get("corrupt"):
+        if fallback and fallback.get("corrupt"):
             rec["last_tpu_measurement_error"] = fallback.get("error")
         elif fallback:
             rec["last_tpu_measurement"] = fallback
@@ -279,14 +279,14 @@ def _arm_process_watchdog(platform: str, deadline: float) -> None:
         "platform": platform,
         "stage_reached": "unknown (parent frozen in native code)",
     }
+    # value stays 0.0 (same contract as the thread watchdog): the cached
+    # on-chip record is surfaced only under last_tpu_measurement, never
+    # as the live value of THIS run
     fallback = _load_last_tpu_record()
-    if fallback and "value" in fallback:
-        rec.update(
-            value=fallback["value"],
-            vs_baseline=fallback.get("vs_baseline", 0.0),
-            from_cached_tpu_measurement=True,
-            last_tpu_measurement=fallback,
-        )
+    if fallback and fallback.get("corrupt"):
+        rec["last_tpu_measurement_error"] = fallback.get("error")
+    elif fallback:
+        rec["last_tpu_measurement"] = fallback
     env = dict(os.environ)
     env["MPCIUM_BENCH_FALLBACK"] = json.dumps(rec)
     # strip the axon plugin: the child imports nothing heavy, but keep
@@ -456,6 +456,31 @@ def main() -> None:
                 B / (time.perf_counter() - t0), 3
             )
             record["gg18_ot_mta_batch"] = B
+            # one phase-profiled pass for the host/device A/B split of
+            # the OT phase: r2_mta_ot_host (worker-thread IKNP time:
+            # PRG + transpose + pad hashing), r2_mta_ot_device
+            # (main-thread block time on device arrays) and the
+            # pipeline's overlap ratio (fraction of host time hidden
+            # behind device compute) — the chunked double-buffer's win,
+            # measured rather than asserted.
+            phases_ot: dict = {}
+            out = signer_ot.sign(digests, phase_times=phases_ot)
+            assert out["ok"].all()
+            record["gg18_ot_mta_phase_s"] = {
+                k: round(v, 3) for k, v in phases_ot.items()
+            }
+            record["gg18_ot_mta_host_s"] = round(
+                phases_ot.get("r2_mta_ot_host", 0.0), 3
+            )
+            record["gg18_ot_mta_device_s"] = round(
+                phases_ot.get("r2_mta_ot_device", 0.0), 3
+            )
+            record["gg18_ot_mta_overlap_ratio"] = round(
+                phases_ot.get("r2_mta_ot_overlap_ratio", 0.0), 3
+            )
+            record["gg18_ot_mta_chunks"] = int(
+                phases_ot.get("r2_mta_ot_chunks", 1)
+            )
         except Exception as e:  # noqa: BLE001
             record["gg18_ot_mta_error"] = repr(e)
         finally:

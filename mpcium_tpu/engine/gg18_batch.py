@@ -1083,16 +1083,42 @@ class GG18BatchCoSigners:
             ok = jnp.ones((B,), bool)
             alpha_shares = {}
             beta_shares = {}
+            # pipeline chunking knob (MPCIUM_OT_CHUNKS; 0/unset → auto
+            # from B) — resolved here so every leg of the quorum runs
+            # the same schedule
+            from ..protocol.ecdsa.mta_ot import resolve_chunks
+
+            ot_chunks = resolve_chunks(B)
+            ot_timings = {} if phase_times is not None else None
             for (a, b) in self.pairs:
                 leg = self.ot_legs[(a, b)]
                 # one extension serves BOTH products (same k_a choice
                 # bits; set-separated pad domains — mta_ot.run_multi)
-                shares = leg.run_multi(k[a], (gamma[b], self.w[b]))
+                shares = leg.run_multi(
+                    k[a], (gamma[b], self.w[b]),
+                    chunks=ot_chunks, timings=ot_timings,
+                )
                 for name, (al, be) in zip(("gamma", "w"), shares):
                     alpha_shares[(a, b, name)] = al
                     beta_shares[(a, b, name)] = be
             _mark("r2_mta_ot",
                   *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs])
+            if phase_times is not None and ot_timings:
+                # host/device A/B split of the OT phase: host_s is
+                # worker-thread busy time, device is main-thread block
+                # time on device arrays; hidden host time (host_s minus
+                # the residual main-thread wait on the worker) over
+                # host_s is the pipeline's overlap ratio.
+                host_s = ot_timings.get("host_s", 0.0)
+                hidden = max(0.0, host_s - ot_timings.get("host_wait_s", 0.0))
+                phase_times["r2_mta_ot_host"] = host_s
+                phase_times["r2_mta_ot_device"] = ot_timings.get(
+                    "device_wait_s", 0.0
+                )
+                phase_times["r2_mta_ot_overlap_ratio"] = (
+                    hidden / host_s if host_s > 0 else 0.0
+                )
+                phase_times["r2_mta_ot_chunks"] = float(ot_chunks)
             return self._finish_sign(
                 _mark, m, ok, k, gamma, Gamma, Gamma_comp,
                 g_commit, g_blind, alpha_shares, beta_shares,

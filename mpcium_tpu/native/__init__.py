@@ -2,9 +2,14 @@
 
 `batch_hash`: row-batched SHA-256/512 for the engines' host hash points
 (commitments, Fiat–Shamir challenges) — one call per batch instead of one
-Python hashlib call per session. Compiled with g++ on first import and
-cached next to the source; falls back to hashlib transparently if no
-toolchain is available.
+Python hashlib call per session — plus the OT-MtA host hot path:
+`ot_transpose` (packed bit-matrix transpose), `prg_expand` (fused
+seed → SHA-256 block expansion) and `xor_rows` (in-place masking).
+Every loop threads across rows; MPCIUM_NATIVE_THREADS pins the count
+(1 = deterministic single-thread mode; outputs are bit-identical at
+any count — rows write disjoint ranges). Compiled with g++ on first
+import and cached next to the source; falls back to hashlib/numpy
+transparently if no toolchain is available.
 """
 from __future__ import annotations
 
@@ -56,6 +61,24 @@ def _build() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
+        if hasattr(lib, "prg_expand"):
+            lib.prg_expand.restype = None
+            lib.prg_expand.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_size_t, ctypes.c_void_p,
+            ]
+        if hasattr(lib, "xor_rows"):
+            lib.xor_rows.restype = None
+            lib.xor_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+            ]
+        if hasattr(lib, "xor_bcast_row"):
+            lib.xor_bcast_row.restype = None
+            lib.xor_bcast_row.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_size_t,
+            ]
         return lib
     except Exception:  # noqa: BLE001 — no toolchain / build failure
         return None
@@ -105,6 +128,10 @@ def ot_transpose(packed: np.ndarray):
         return None
     packed = np.ascontiguousarray(packed, dtype=np.uint8)
     kappa = packed.shape[0]  # matrix rows == trow bits
+    # kappa // 8 below would silently DROP the trailing bits of every
+    # column for a non-multiple-of-8 kappa (safe today at KAPPA=128,
+    # silent corruption for any future parameter change)
+    assert kappa % 8 == 0, f"ot_transpose: kappa={kappa} not a multiple of 8"
     m = packed.shape[1] * 8
     out = np.empty((m, kappa // 8), dtype=np.uint8)
     lib.ot_transpose(
@@ -112,6 +139,63 @@ def ot_transpose(packed: np.ndarray):
         out.ctypes.data_as(ctypes.c_void_p),
     )
     return out
+
+
+def prg_expand(
+    prefix: bytes, seeds: np.ndarray, n_blocks: int, blk_off: int = 0
+):
+    """Fused PRG expansion (see batch_hash.cpp): each 32-byte seed row
+    j expands to ``n_blocks`` SHA-256 blocks
+    sha256(prefix ‖ seed_j ‖ le16(j) ‖ le32(blk_off + b)) →
+    (n_seeds, n_blocks*32). None when the native library (or this
+    entry point) is unavailable — caller falls back to the numpy
+    row-assembly path (bit-identical stream)."""
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "prg_expand"):
+        return None
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint8)
+    n_seeds = seeds.shape[0]
+    assert seeds.shape[1] == 32 and n_seeds < (1 << 16)
+    out = np.empty((n_seeds, n_blocks * 32), dtype=np.uint8)
+    lib.prg_expand(
+        prefix, len(prefix),
+        seeds.ctypes.data_as(ctypes.c_void_p), n_seeds, n_blocks, blk_off,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def xor_rows(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """In-place ``dst ^= src`` and return ``dst``. ``src`` is either the
+    same size as ``dst`` or a single row broadcast across dst's leading
+    axes. Rides the threaded native xor when built (thread count via
+    MPCIUM_NATIVE_THREADS); numpy in-place otherwise — either way no
+    fresh result array is materialized."""
+    lib = _get_lib()
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    if (
+        lib is None
+        or not hasattr(lib, "xor_rows")
+        or dst.dtype != np.uint8
+        or not dst.flags.c_contiguous
+        or not dst.flags.writeable
+    ):
+        np.bitwise_xor(dst, src, out=dst)
+        return dst
+    if src.size == dst.size:
+        lib.xor_rows(
+            dst.ctypes.data_as(ctypes.c_void_p),
+            src.ctypes.data_as(ctypes.c_void_p), dst.size,
+        )
+    elif dst.size % src.size == 0 and hasattr(lib, "xor_bcast_row"):
+        lib.xor_bcast_row(
+            dst.ctypes.data_as(ctypes.c_void_p),
+            src.ctypes.data_as(ctypes.c_void_p),
+            dst.size // src.size, src.size,
+        )
+    else:
+        np.bitwise_xor(dst, src, out=dst)
+    return dst
 
 
 def batch_sha512(prefix: bytes, rows: np.ndarray) -> np.ndarray:

@@ -10,6 +10,7 @@
 // (driven automatically by mpcium_tpu.native on first import).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -169,11 +170,26 @@ void sha512_one(const uint8_t* msg, size_t len, uint8_t out[64]) {
       out[8 * i + j] = uint8_t(h[i] >> (56 - 8 * j));
 }
 
+// Thread count: MPCIUM_NATIVE_THREADS pins it (1 = deterministic
+// single-thread mode, checked per call so tests can flip it);
+// otherwise hardware_concurrency. Every parallelized loop writes
+// disjoint output ranges, so results are bit-identical at any count.
+unsigned resolve_threads() {
+  const char* env = std::getenv("MPCIUM_NATIVE_THREADS");
+  if (env && *env) {
+    long v = std::strtol(env, nullptr, 10);
+    if (v >= 1) return unsigned(v);
+  }
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 4 : n;
+}
+
 template <typename F>
 void parallel_rows(size_t rows, F fn) {
-  unsigned n_threads = std::thread::hardware_concurrency();
-  if (n_threads == 0) n_threads = 4;
-  if (rows < 256) {  // below this the thread spawn costs more than it saves
+  unsigned n_threads = resolve_threads();
+  if (n_threads == 1 || rows < 256) {
+    // single-thread pin, or below the point where spawn costs more
+    // than it saves
     for (size_t i = 0; i < rows; ++i) fn(i);
     return;
   }
@@ -228,6 +244,55 @@ void batch_sha512(const uint8_t* prefix, size_t prefix_len,
 // once. Row hashing (with per-payload-set prefixes) rides
 // batch_sha256, so a multi-set extension pays the transpose exactly
 // once however many pad domains it derives.
+// Fused PRG expansion (the OT-MtA host hot path next to the
+// transpose). Each 32-byte seed row j expands to n_blocks SHA-256
+// blocks: out[j][b] = sha256(prefix || seed_j || le16(j) ||
+// le32(blk_off + b)). Identical stream to mta_ot._prg's numpy
+// fallback, which materializes the full (n_seeds * n_blocks, 38)
+// message matrix before hashing; this builds each 38-byte message in
+// a thread-local stack buffer. blk_off lets a chunked pipeline expand
+// a block sub-range that concatenates bit-exactly with its
+// neighbours.
+void prg_expand(const uint8_t* prefix, size_t prefix_len,
+                const uint8_t* seeds, size_t n_seeds, size_t n_blocks,
+                size_t blk_off, uint8_t* out) {
+  parallel_rows(n_seeds * n_blocks, [=](size_t i) {
+    const size_t j = i / n_blocks;
+    const uint32_t blk = uint32_t(blk_off + i % n_blocks);
+    std::vector<uint8_t> buf(prefix_len + 38);
+    std::memcpy(buf.data(), prefix, prefix_len);
+    std::memcpy(buf.data() + prefix_len, seeds + j * 32, 32);
+    buf[prefix_len + 32] = uint8_t(j);
+    buf[prefix_len + 33] = uint8_t(j >> 8);
+    for (int k = 0; k < 4; ++k)
+      buf[prefix_len + 34 + k] = uint8_t(blk >> (8 * k));
+    sha256_one(buf.data(), buf.size(), out + i * 32);
+  });
+}
+
+// In-place dst ^= src over n bytes, threaded in 64 KiB stripes. The
+// OT-MtA masking legs (y0/y1 ^= pad, t0^t1, pad ^= payload) otherwise
+// materialize a fresh ~M x 32 numpy temporary per xor.
+void xor_rows(uint8_t* dst, const uint8_t* src, size_t n) {
+  const size_t stripe = size_t(1) << 16;
+  const size_t n_stripes = (n + stripe - 1) / stripe;
+  parallel_rows(n_stripes, [=](size_t i) {
+    const size_t lo = i * stripe;
+    const size_t hi = lo + stripe < n ? lo + stripe : n;
+    for (size_t k = lo; k < hi; ++k) dst[k] ^= src[k];
+  });
+}
+
+// dst[r] ^= row for every one of n_rows rows (the U ^= r_packed
+// broadcast leg).
+void xor_bcast_row(uint8_t* dst, const uint8_t* row, size_t n_rows,
+                   size_t row_len) {
+  parallel_rows(n_rows, [=](size_t r) {
+    uint8_t* d = dst + r * row_len;
+    for (size_t k = 0; k < row_len; ++k) d[k] ^= row[k];
+  });
+}
+
 void ot_transpose(const uint8_t* packed, size_t kappa, size_t m,
                   uint8_t* out) {
   const size_t kb = kappa / 8;

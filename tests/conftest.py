@@ -40,6 +40,43 @@ if not os.environ.get("MPCIUM_TESTS_NO_CACHE"):
 import pytest  # noqa: E402
 
 
+def run_isolated(test_file: str, test_name: str, inner_env: str,
+                 timeout: int = 3300) -> None:
+    """Run one test in a fresh pytest subprocess (the shared machinery
+    of the heavy distributed suites — previously three near-identical
+    copies). ``inner_env`` is the wrapper-recursion guard the file's
+    inner test checks. On one observed (post-migration) host, XLA:CPU
+    deterministically segfaults compiling these suites' graphs; the
+    subprocess keeps a crash from killing the whole pytest process, and
+    MPCIUM_XFAIL_XLA_CRASH=1 (opt-in, known-bad hosts only) downgrades
+    that specific crash class to xfail instead of letting a real crash
+    regression merge green everywhere."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env[inner_env] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", f"{test_file}::{test_name}",
+             "-q", "--no-header"],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(
+            f"isolated {test_name} timed out:\n"
+            f"{(e.stdout or '')[-2000:]}{(e.stderr or '')[-1000:]}"
+        )
+    # -11 = SIGSEGV, -6 = SIGABRT (XLA CHECK failure -> abort)
+    if (r.returncode in (-11, -6)
+            and os.environ.get("MPCIUM_XFAIL_XLA_CRASH") == "1"):
+        pytest.xfail(
+            "XLA:CPU crashed compiling this test's graphs on this host "
+            "(known host-specific codegen crash; green on healthy hosts)"
+        )
+    assert r.returncode == 0, (r.stdout[-3000:] + r.stderr[-2000:])
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

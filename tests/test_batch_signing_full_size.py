@@ -22,13 +22,12 @@ where XLA:CPU is healthy and the distributed path itself is proven at
 """
 import os
 import secrets
-import subprocess
-import sys
 
-import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
+
+from conftest import run_isolated
 
 _INNER = os.environ.get("MPCIUM_BSIGN_FULL_INNER")
 
@@ -38,27 +37,10 @@ N_WALLETS = 4
 def test_full_size_batch_signing_isolated():
     if _INNER:
         pytest.skip("wrapper entry; inner run executes the real test")
-    env = dict(os.environ)
-    env["MPCIUM_BSIGN_FULL_INNER"] = "1"
-    try:
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest",
-             f"{__file__}::test_full_size_batch_signing_inner",
-             "-q", "--no-header"],
-            env=env, capture_output=True, text=True, timeout=5400,
-        )
-    except subprocess.TimeoutExpired as e:
-        pytest.fail(
-            "isolated full-size batch signing timed out:\n"
-            f"{(e.stdout or '')[-2000:]}{(e.stderr or '')[-1000:]}"
-        )
-    if (r.returncode in (-11, -6)
-            and os.environ.get("MPCIUM_XFAIL_XLA_CRASH") == "1"):
-        pytest.xfail(
-            "XLA:CPU crashed compiling this test's graphs on this host "
-            "(known host-specific codegen crash; green on healthy hosts)"
-        )
-    assert r.returncode == 0, (r.stdout[-3000:] + r.stderr[-2000:])
+    run_isolated(
+        __file__, "test_full_size_batch_signing_inner",
+        "MPCIUM_BSIGN_FULL_INNER", timeout=5400,
+    )
 
 
 @pytest.mark.skipif(not _INNER, reason="runs via the subprocess wrapper")

@@ -11,44 +11,23 @@ MPCIUM_XFAIL_XLA_CRASH=1 (opt-in, known-bad hosts only) downgrades it
 to xfail; everything is green where XLA:CPU is healthy."""
 import os
 import secrets
-import subprocess
-import sys
 
-import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
 
+from conftest import run_isolated
+
 _INNER = os.environ.get("MPCIUM_GG18_PARTY_INNER")
-
-
-def _run_isolated(test_name: str) -> None:
-    env = dict(os.environ)
-    env["MPCIUM_GG18_PARTY_INNER"] = "1"
-    try:
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest", f"{__file__}::{test_name}",
-             "-q", "--no-header"],
-            env=env, capture_output=True, text=True, timeout=3300,
-        )
-    except subprocess.TimeoutExpired as e:
-        pytest.fail(
-            f"isolated {test_name} timed out:\n"
-            f"{(e.stdout or '')[-2000:]}{(e.stderr or '')[-1000:]}"
-        )
-    if (r.returncode in (-11, -6)
-            and os.environ.get("MPCIUM_XFAIL_XLA_CRASH") == "1"):
-        pytest.xfail(
-            "XLA:CPU crashed compiling this test's graphs on this host "
-            "(known host-specific codegen crash; green on healthy hosts)"
-        )
-    assert r.returncode == 0, (r.stdout[-3000:] + r.stderr[-2000:])
 
 
 def test_two_party_batch_isolated():
     if _INNER:
         pytest.skip("wrapper entry; inner run executes the real test")
-    _run_isolated("test_two_party_batch_signs_and_verifies")
+    run_isolated(
+        __file__, "test_two_party_batch_signs_and_verifies",
+        "MPCIUM_GG18_PARTY_INNER",
+    )
 
 
 from mpcium_tpu.core import hostmath as hm

@@ -1,0 +1,221 @@
+"""Chunked/pipelined OT-MtA (ISSUE 2): scheduling must never change
+values. The double-buffered run_multi — host PRG/transpose/pad work
+overlapped with device mod-q compute, chunked along the batch — has to
+be BIT-identical to the serial three-round composition for every chunk
+count, with or without the native library, at any thread count.
+
+Base OTs are synthesized directly from their postcondition
+(keysD[j] = k^{Δ_j}_j) instead of running the Chou–Orlandi device
+ladders, so this file stays in the fast tier; the real base-OT path is
+covered by test_mta_ot.py (slow)."""
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpcium_tpu.core import bignum as bn
+from mpcium_tpu.core.bignum import P256
+from mpcium_tpu.protocol.ecdsa import mta_ot
+
+Q = mta_ot.Q
+B = 4
+
+
+class DetRng:
+    """Deterministic CSPRNG stand-in: a hash-counter stream, so two
+    instances with one seed draw identical bytes in identical call
+    order (the bit-exactness fixture)."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.ctr = 0
+
+    def token_bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += hashlib.sha256(
+                b"detrng|%d|%d" % (self.seed, self.ctr)
+            ).digest()
+            self.ctr += 1
+        return bytes(out[:n])
+
+    def randbelow(self, n: int) -> int:
+        return int.from_bytes(self.token_bytes(40), "big") % n
+
+
+def synth_leg(seed: int) -> mta_ot.OTMtALeg:
+    """OTMtALeg with synthetic base-OT material satisfying the base-OT
+    postcondition, skipping the curve ladders."""
+    rng = DetRng(seed)
+    leg = mta_ot.OTMtALeg.__new__(mta_ot.OTMtALeg)
+    leg.tag = b"t-pipe|%d" % seed
+    leg.rng = DetRng(seed + 1000)
+    leg.ctr = 0
+    leg.k0 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.k1 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.delta = np.frombuffer(rng.token_bytes(mta_ot.KAPPA), np.uint8) & 1
+    leg.keysD = np.where(leg.delta[:, None].astype(bool), leg.k1, leg.k0)
+    leg.delta_packed = mta_ot._pack(leg.delta)
+    leg._delta_rows = np.nonzero(leg.delta)[0]
+    return leg
+
+
+def _limbs(vals):
+    return jnp.asarray(bn.batch_to_limbs(vals, P256))
+
+
+def _ints(arr):
+    return bn.batch_from_limbs(np.asarray(arr), P256)
+
+
+@pytest.fixture(scope="module")
+def fixed_inputs():
+    r = DetRng(7)
+    a = [r.randbelow(Q) for _ in range(B)]
+    g = [r.randbelow(Q) for _ in range(B)]
+    w = [r.randbelow(Q) for _ in range(B)]
+    a[0] = 0
+    g[1] = Q - 1
+    return a, g, w
+
+
+@pytest.fixture(scope="module")
+def serial_reference(fixed_inputs):
+    """The pre-pipeline path: explicit three-round composition (full
+    width, no chunking, no worker thread)."""
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(1)
+    msg_a = leg.alice_round1(_limbs(a_ints), 0)
+    msgs_b, betas = leg.bob_round2_multi(
+        (_limbs(g_ints), _limbs(w_ints)), msg_a, 0
+    )
+    alphas = leg.alice_round3_multi(msgs_b)
+    ref = [
+        (np.asarray(al), np.asarray(be)) for al, be in zip(alphas, betas)
+    ]
+    # ground truth first: the reference itself multiplies correctly
+    for (al, be), b_ints in zip(ref, (g_ints, w_ints)):
+        ai, bi = _ints(al), _ints(be)
+        for i in range(B):
+            assert (ai[i] + bi[i]) % Q == a_ints[i] * b_ints[i] % Q, i
+    return ref
+
+
+@pytest.mark.parametrize("K", [1, 2, 4])
+def test_chunked_pipeline_bit_identical_to_serial(
+    K, fixed_inputs, serial_reference
+):
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(1)  # same seed → same base material + rng stream
+    out = leg.run_multi(
+        _limbs(a_ints), (_limbs(g_ints), _limbs(w_ints)), chunks=K
+    )
+    for s, (al, be) in enumerate(out):
+        assert np.array_equal(np.asarray(al), serial_reference[s][0]), (
+            f"K={K} set {s}: alpha diverged from the serial path"
+        )
+        assert np.array_equal(np.asarray(be), serial_reference[s][1]), (
+            f"K={K} set {s}: beta diverged from the serial path"
+        )
+
+
+def test_numpy_fallback_bit_identical(
+    monkeypatch, fixed_inputs, serial_reference
+):
+    """Without libbatchhash.so the whole OT-MtA path (PRG, transpose,
+    xor, pads) must still run — numpy/hashlib only — and produce the
+    same bytes (environment memory: the soft fallback stays importable
+    AND correct)."""
+    from mpcium_tpu import native
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    assert not native.available()
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(1)
+    out = leg.run_multi(
+        _limbs(a_ints), (_limbs(g_ints), _limbs(w_ints)), chunks=2
+    )
+    for s, (al, be) in enumerate(out):
+        assert np.array_equal(np.asarray(al), serial_reference[s][0])
+        assert np.array_equal(np.asarray(be), serial_reference[s][1])
+
+
+def test_single_thread_pin_bit_identical(
+    monkeypatch, fixed_inputs, serial_reference
+):
+    """MPCIUM_NATIVE_THREADS=1 (deterministic single-thread mode) —
+    same transcripts, same shares."""
+    monkeypatch.setenv("MPCIUM_NATIVE_THREADS", "1")
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(1)
+    out = leg.run_multi(
+        _limbs(a_ints), (_limbs(g_ints), _limbs(w_ints)), chunks=4
+    )
+    for s, (al, be) in enumerate(out):
+        assert np.array_equal(np.asarray(al), serial_reference[s][0])
+        assert np.array_equal(np.asarray(be), serial_reference[s][1])
+
+
+def test_payload_set_shape_contract():
+    """Mismatched payload-set batch shapes fail at entry with a
+    contract error, not an opaque broadcast error downstream."""
+    leg = synth_leg(2)
+    a = _limbs([3, 5])
+    good = _limbs([7, 11])
+    bad = _limbs([7, 11, 13])
+    with pytest.raises(ValueError, match="payload sets disagree"):
+        leg.run_multi(a, (good, bad))
+    with pytest.raises(ValueError, match="payload sets disagree"):
+        leg.bob_round2_multi(
+            (good, bad), {"U": None, "v": mta_ot.OT_WIRE_VERSION}, 0
+        )
+
+
+def test_wire_version_mismatch_fails_loudly():
+    """A peer speaking another extension-layer version (or a pre-v2
+    message with no version field at all) is rejected with a clear
+    error instead of unmasking garbage pads."""
+    leg = synth_leg(3)
+    a = _limbs([3, 5])
+    b = _limbs([7, 11])
+    msg_a = leg.alice_round1(a, 0)
+    assert msg_a["v"] == mta_ot.OT_WIRE_VERSION
+
+    legacy = {"U": msg_a["U"]}  # pre-v2: no version field
+    with pytest.raises(ValueError, match="version mismatch"):
+        leg.bob_round2_multi((b,), legacy, 0)
+    with pytest.raises(ValueError, match="version mismatch"):
+        leg.bob_round2_multi(
+            (b,), {"U": msg_a["U"], "v": mta_ot.OT_WIRE_VERSION + 1}, 0
+        )
+
+    msgs_b, _betas = leg.bob_round2_multi((b,), msg_a, 0)
+    stripped = [{k: v for k, v in m.items() if k != "v"} for m in msgs_b]
+    with pytest.raises(ValueError, match="version mismatch"):
+        leg.alice_round3_multi(stripped)
+    # and the well-versioned message still flows
+    (alpha,) = leg.alice_round3_multi(msgs_b)
+    assert np.asarray(alpha).shape[0] == 2
+
+
+def test_resolve_chunks(monkeypatch):
+    monkeypatch.delenv("MPCIUM_OT_CHUNKS", raising=False)
+    # auto: ~B/256 capped at 8, min 1, and always a divisor of B
+    assert mta_ot.resolve_chunks(2) == 1
+    assert mta_ot.resolve_chunks(1024) == 4
+    assert mta_ot.resolve_chunks(4096) == 8
+    # explicit argument wins and is clamped to a divisor
+    assert mta_ot.resolve_chunks(8, 3) == 2
+    assert mta_ot.resolve_chunks(8, 64) == 8
+    # env knob
+    monkeypatch.setenv("MPCIUM_OT_CHUNKS", "2")
+    assert mta_ot.resolve_chunks(1024) == 2
+    monkeypatch.setenv("MPCIUM_OT_CHUNKS", "0")
+    assert mta_ot.resolve_chunks(1024) == 4

@@ -3,6 +3,7 @@ import hashlib
 import secrets
 
 import numpy as np
+import pytest
 
 from mpcium_tpu import native
 
@@ -49,3 +50,74 @@ def test_ot_transpose_matches_numpy():
         want = np.packbits(bits.T, axis=-1, bitorder="little")  # (M, 16)
         got = native.ot_transpose(packed)
         assert got is not None and (got == want).all()
+
+
+def test_ot_transpose_rejects_non_multiple_of_8_kappa():
+    """kappa % 8 != 0 would silently drop the trailing column bits
+    (out is allocated kappa // 8 wide) — must fail loudly instead."""
+    with pytest.raises(AssertionError, match="kappa=12"):
+        native.ot_transpose(np.zeros((12, 8), dtype=np.uint8))
+
+
+def test_prg_expand_matches_reference_stream():
+    """Fused native PRG vs the documented sha256(prefix ‖ seed ‖
+    le16(j) ‖ le32(blk)) stream, including a nonzero block offset."""
+    rng = np.random.default_rng(10)
+    seeds = rng.integers(0, 256, size=(5, 32), dtype=np.uint8)
+    prefix = b"mpcium-ot-prg|t"
+    for blk_off in (0, 7):
+        got = native.prg_expand(prefix, seeds, 3, blk_off=blk_off)
+        assert got is not None and got.shape == (5, 96)
+        for j in range(5):
+            for b in range(3):
+                msg = (
+                    prefix + seeds[j].tobytes()
+                    + int(j).to_bytes(2, "little")
+                    + int(blk_off + b).to_bytes(4, "little")
+                )
+                expect = hashlib.sha256(msg).digest()
+                assert got[j, b * 32:(b + 1) * 32].tobytes() == expect
+
+
+def test_prg_expand_chunks_concatenate():
+    """Block-offset sub-ranges concatenate to the full expansion (the
+    pipeline's chunking invariant)."""
+    rng = np.random.default_rng(11)
+    seeds = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    full = native.prg_expand(b"p", seeds, 8)
+    parts = [
+        native.prg_expand(b"p", seeds, 2, blk_off=o) for o in (0, 2, 4, 6)
+    ]
+    assert (np.concatenate(parts, axis=1) == full).all()
+
+
+def test_xor_rows_in_place_and_broadcast():
+    rng = np.random.default_rng(12)
+    a = rng.integers(0, 256, size=(6, 40), dtype=np.uint8)
+    b = rng.integers(0, 256, size=(6, 40), dtype=np.uint8)
+    want = a ^ b
+    got = native.xor_rows(a, b)
+    assert got is a and (a == want).all()  # in place, no new array
+    row = rng.integers(0, 256, size=(40,), dtype=np.uint8)
+    want = a ^ row
+    native.xor_rows(a, row)  # broadcast leg
+    assert (a == want).all()
+
+
+def test_native_threads_env_is_pure_scheduling(monkeypatch):
+    """MPCIUM_NATIVE_THREADS must never change output bytes — 1-thread
+    pin vs multithread across every threaded entry point."""
+    rng = np.random.default_rng(13)
+    rows = rng.integers(0, 256, size=(700, 64), dtype=np.uint8)
+    packed = rng.integers(0, 256, size=(128, 128), dtype=np.uint8)
+    seeds = rng.integers(0, 256, size=(128, 32), dtype=np.uint8)
+
+    monkeypatch.setenv("MPCIUM_NATIVE_THREADS", "1")
+    h1 = native.batch_sha256(b"t", rows)
+    t1 = native.ot_transpose(packed)
+    p1 = native.prg_expand(b"t", seeds, 4)
+    monkeypatch.setenv("MPCIUM_NATIVE_THREADS", "4")
+    h4 = native.batch_sha256(b"t", rows)
+    t4 = native.ot_transpose(packed)
+    p4 = native.prg_expand(b"t", seeds, 4)
+    assert (h1 == h4).all() and (t1 == t4).all() and (p1 == p4).all()
