@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install test test-all bench broker setup-identities setup-initiator clean
+.PHONY: install test test-all bench broker chaos setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -28,6 +28,14 @@ test-all:
 
 bench:
 	$(PY) bench.py
+
+# chaos drills (ISSUE 3): the full catalog, JSON reports, non-zero exit
+# on any missed expected outcome; reproduce a failure with --seed
+chaos:
+	$(PY) scripts/chaos_drill.py --seed 7
+
+chaos-tests:
+	$(PY) -m pytest tests/ -m chaos -q
 
 # dev stack: durable broker on :4333 (the docker-compose/nats analogue)
 broker:

@@ -127,6 +127,11 @@ class LocalCluster(SyncOps):
         transport: str = "loopback",  # "loopback" | "tcp"
         batch_signing: bool = False,
         batch_window_s: float = 0.05,
+        fault_plans: Optional[Dict] = None,  # node_id|"*"|"client" → FaultPlan
+        broker_standby: bool = False,  # tcp only: hot-standby broker pair
+        hello_timeout_s: Optional[float] = 20.0,
+        session_timeout_s: Optional[float] = None,  # EventConsumer GC knobs
+        gc_interval_s: Optional[float] = None,  # (chaos drills shrink both)
     ):
         from .config import init_config
 
@@ -135,17 +140,34 @@ class LocalCluster(SyncOps):
         init_config(path=str(self.root / "nonexistent.yaml"),
                     mpc_threshold=threshold)
         self.broker = None
+        self.standby_broker = None
         if transport == "tcp":
             from .transport.tcp import BrokerServer, tcp_transport
 
             self.broker = BrokerServer(port=0)
+            standbys = None
+            if broker_standby:
+                self.standby_broker = BrokerServer(
+                    port=0, follow=(self.broker.host, self.broker.port)
+                )
+                assert self.standby_broker._rep_synced.wait(10), (
+                    "standby broker never synced to primary"
+                )
+                standbys = [(self.standby_broker.host,
+                             self.standby_broker.port)]
             self._mk_transport = lambda: tcp_transport(
-                self.broker.host, self.broker.port
+                self.broker.host, self.broker.port, standbys=standbys
             )
             self.fabric = None
         else:
             self.fabric = LoopbackFabric()
             self._mk_transport = self.fabric.transport
+        # fault-injection seam (mpcium_tpu/faults): nodes with a plan get
+        # their transport wrapped; with no plan nothing is constructed and
+        # behavior is byte-identical to a bare cluster
+        self._fault_plans = fault_plans or {}
+        self.fault_transports: Dict[str, object] = {}
+        self._hello_timeout_s = hello_timeout_s
         self.control_kv = MemoryKV()  # the Consul analogue
 
         # identities (setup_identities.sh equivalent)
@@ -169,7 +191,7 @@ class LocalCluster(SyncOps):
             registry = PeerRegistry(
                 nid, self.node_ids, self.control_kv, poll_interval_s=0.05
             )
-            transport = self._mk_transport()
+            transport = self._wrap_faults(nid, self._mk_transport())
             node = Node(
                 node_id=nid,
                 peer_ids=self.node_ids,
@@ -180,12 +202,19 @@ class LocalCluster(SyncOps):
                 registry=registry,
                 preparams=preparams.get(nid),
                 min_paillier_bits=min_paillier_bits,
+                hello_timeout_s=self._hello_timeout_s,
             )
             self.nodes[nid] = node
+            ec_kw = {}
+            if session_timeout_s is not None:
+                ec_kw["session_timeout_s"] = session_timeout_s
+            if gc_interval_s is not None:
+                ec_kw["gc_interval_s"] = gc_interval_s
             ec = EventConsumer(
                 node, transport,
                 batch_signing=batch_signing,
                 batch_window_s=batch_window_s,
+                **ec_kw,
             )
             ec.run()
             self.consumers.append(ec)
@@ -197,7 +226,24 @@ class LocalCluster(SyncOps):
         for node in self.nodes.values():
             assert node.registry.wait_all_ready(10), "cluster failed to form"
         log.info("local cluster ready", nodes=n_nodes, threshold=threshold)
-        self.client = MPCClient(self._mk_transport(), self.initiator)
+        self.client = MPCClient(
+            self._wrap_faults("client", self._mk_transport()), self.initiator
+        )
+
+    def _wrap_faults(self, owner: str, transport):
+        """Wrap ``transport`` in a FaultyTransport when a fault plan is
+        installed for ``owner`` (or under the "*" wildcard). No plan ⇒
+        the bare transport passes through untouched."""
+        plan = self._fault_plans.get(owner) or (
+            self._fault_plans.get("*") if owner != "client" else None
+        )
+        if plan is None:
+            return transport
+        from .faults.transport import FaultyTransport
+
+        ft = FaultyTransport(transport, owner, plan)
+        self.fault_transports[owner] = ft
+        return ft
 
     def close(self) -> None:
         for ec in self.consumers:
@@ -206,10 +252,14 @@ class LocalCluster(SyncOps):
             sc.close()
         for node in self.nodes.values():
             node.registry.resign()
+        for ft in self.fault_transports.values():
+            ft.close()
         if self.fabric is not None:
             self.fabric.close()
         if self.broker is not None:
             self.broker.close()
+        if self.standby_broker is not None:
+            self.standby_broker.close()
 
 
 class RemoteCluster(SyncOps):

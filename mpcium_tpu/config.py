@@ -39,6 +39,7 @@ class AppConfig:
     broker_standbys: str = ""  # failover endpoints, "host:port[,host:port]"
     batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
     batch_window_s: float = 0.05
+    chaos_fault_plan: str = ""  # path to a faults.FaultPlan JSON ("" = off)
     peers_file: str = "peers.json"
 
     def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:

@@ -1,0 +1,27 @@
+"""Deterministic fault-injection & chaos drills (ISSUE 3).
+
+The committee's value proposition is surviving partial failure; this
+package attacks the failure surface on purpose, reproducibly:
+
+- :mod:`.plan` — declarative, seed-deterministic fault plans (drop /
+  delay / duplicate / reorder / crash / partition rules with match
+  predicates and per-rule PRF streams);
+- :mod:`.transport` — a :class:`~.transport.FaultyTransport` decorator
+  over any :class:`~..transport.api.Transport` that applies the active
+  plan on publish/deliver, plus the node crash switch;
+- :mod:`.chaos` — the drill runner: stands up an in-process cluster,
+  executes keygen → signing → reshare under a plan, and emits a
+  structured, reproducible drill report (scripts/chaos_drill.py).
+"""
+from .plan import (  # noqa: F401
+    FaultPlan,
+    Rule,
+    crash_node,
+    delay,
+    drop,
+    duplicate,
+    named_plan,
+    partition,
+    reorder,
+)
+from .transport import CrashSwitch, FaultStats, FaultyTransport  # noqa: F401

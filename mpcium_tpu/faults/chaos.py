@@ -1,0 +1,454 @@
+"""Chaos drill runner: reproducible failure drills over a live cluster.
+
+Each drill stands up an in-process :class:`~..cluster.LocalCluster`
+(loopback or TCP+standby), runs real protocol work — EdDSA keygen →
+signing → resharing through the full client/queue/consumer path — under
+a seed-deterministic :class:`~.plan.FaultPlan`, and emits a structured
+:class:`DrillReport`. ``scripts/chaos_drill.py`` is the CLI; the fast
+deterministic variants run in the test tier under the ``chaos`` marker.
+
+Drill catalog (expected outcome in parentheses):
+
+- ``node-crash`` (recovered) — node2 SIGKILLs the instant it joins its
+  first signing session; the tx fails LOUDLY, the committee detects the
+  death via heartbeat staleness and signs with t+1 survivors, the node
+  restarts, rejoins and signs again — then the wallet reshares cleanly.
+- ``drop-jitter`` (success) — 10 % loss on every acked protocol unicast
+  plus 50–200 ms jitter on all protocol traffic; the retry budgets
+  absorb it and keygen → signing → reshare all complete.
+- ``broker-failover`` (success) — TCP transport, hot-standby broker;
+  the primary dies mid-run and clients transparently fail over.
+- ``partition`` (loud-failure-then-recovery) — two of three nodes are
+  isolated (over threshold: no quorum can form anywhere); signing fails
+  loudly and retryably — a bounded timeout ERROR event, no hang, no
+  silent corruption — and succeeds after the partition heals.
+
+Reproducing a failed drill: the report carries ``seed`` and the full
+plan JSON; ``scripts/chaos_drill.py --plan <name> --seed <seed>`` reruns
+the identical fault schedule (see plan.py's determinism contract).
+"""
+from __future__ import annotations
+
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import wire
+from ..cluster import LocalCluster, load_test_preparams
+from ..utils import log
+from .plan import FaultPlan, named_plan
+from .transport import FaultStats
+
+DEFAULT_SEED = 7
+
+
+@dataclass
+class DrillReport:
+    name: str
+    seed: int
+    expected: str
+    outcome: str
+    ok: bool
+    duration_s: float
+    plan: dict = field(default_factory=dict)
+    faults: dict = field(default_factory=dict)
+    notes: List[str] = field(default_factory=list)
+    error: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "expected": self.expected,
+            "outcome": self.outcome,
+            "ok": self.ok,
+            "duration_s": round(self.duration_s, 3),
+            "plan": self.plan,
+            "faults": self.faults,
+            "notes": self.notes,
+            "error": self.error,
+        }
+
+
+def _wait(cond: Callable[[], bool], timeout_s: float,
+          poll_s: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+# -- cluster plumbing --------------------------------------------------------
+
+
+def _mk_cluster(fault_plans: Optional[Dict[str, FaultPlan]] = None,
+                transport: str = "loopback",
+                broker_standby: bool = False,
+                hello_timeout_s: float = 4.0,
+                reply_timeout_s: float = 6.0,
+                session_timeout_s: float = 12.0,
+                gc_interval_s: float = 1.0) -> Tuple[LocalCluster, str]:
+    """A 3-node t=1 drill cluster with tightened failure deadlines, so
+    loud failures surface inside the drill budget instead of the
+    production 30-minute GC."""
+    root = tempfile.mkdtemp(prefix="mpcium-chaos-")
+    cluster = LocalCluster(
+        n_nodes=3,
+        threshold=1,
+        root_dir=root,
+        preparams=load_test_preparams(bits=1024),
+        transport=transport,
+        broker_standby=broker_standby,
+        fault_plans=fault_plans,
+        hello_timeout_s=hello_timeout_s,
+        reply_timeout_s=reply_timeout_s,
+        session_timeout_s=session_timeout_s,
+        gc_interval_s=gc_interval_s,
+    )
+    return cluster, root
+
+
+def _close(cluster: LocalCluster, root: str) -> None:
+    try:
+        cluster.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _merged_stats(cluster: LocalCluster) -> FaultStats:
+    merged = FaultStats()
+    for ft in cluster.fault_transports.values():
+        merged.merge(ft.stats)
+    return merged
+
+
+def _eddsa_keygen(cluster: LocalCluster, wallet_id: str,
+                  timeout_s: float = 60.0, attempts: int = 3) -> int:
+    """EdDSA-only distributed keygen via direct sessions on every node
+    (wallet creation through the client forces the heavyweight GG18
+    curve too; drills exercise the failure machinery, not Paillier).
+    Returns the number of attempts used."""
+    from ..config import get_config
+
+    threshold = get_config().mpc_threshold
+    last_err: Optional[str] = None
+    for attempt in range(1, attempts + 1):
+        sessions = [
+            node.create_keygen_session(
+                wire.KEY_TYPE_ED25519, wallet_id, threshold
+            )
+            for node in cluster.nodes.values()
+        ]
+        for s in sessions:
+            s.listen()
+        ok = True
+        for s in sessions:
+            if not s.wait(timeout_s) or s.failed:
+                ok = False
+        for s in sessions:
+            s.close()
+        if ok:
+            return attempt
+        last_err = "; ".join(
+            s.session_id for s in sessions if s.failed
+        ) or "timeout"
+        log.warn("drill keygen attempt failed; retrying",
+                 wallet=wallet_id, attempt=attempt, detail=last_err)
+    raise RuntimeError(
+        f"eddsa keygen for {wallet_id!r} failed after {attempts} "
+        f"attempts: {last_err}"
+    )
+
+
+def _sign(cluster: LocalCluster, wallet_id: str, tx_id: str,
+          timeout_s: float = 60.0) -> wire.SigningResultEvent:
+    return cluster.sign_sync(
+        wire.SignTxMessage(
+            key_type=wire.KEY_TYPE_ED25519,
+            wallet_id=wallet_id,
+            network_internal_code="chaos",
+            tx_id=tx_id,
+            tx=b"chaos:" + tx_id.encode(),
+        ),
+        timeout_s=timeout_s,
+    )
+
+
+def _sign_retrying(cluster: LocalCluster, wallet_id: str, tx_base: str,
+                   notes: List[str], attempts: int = 3,
+                   timeout_s: float = 60.0) -> wire.SigningResultEvent:
+    """Client-level retry: terminal errors and timeouts re-submit under a
+    FRESH tx id (result queues are idempotent per tx id — a retry that
+    reused the id of a failed tx would have its success deduped against
+    the old error event)."""
+    last: Optional[wire.SigningResultEvent] = None
+    for attempt in range(1, attempts + 1):
+        tx_id = tx_base if attempt == 1 else f"{tx_base}~retry{attempt - 1}"
+        try:
+            ev = _sign(cluster, wallet_id, tx_id, timeout_s=timeout_s)
+        except TimeoutError as e:
+            notes.append(f"{tx_id}: client-side timeout ({e})")
+            continue
+        except Exception as e:  # noqa: BLE001 — e.g. enqueue during failover
+            notes.append(f"{tx_id}: submit failed retryably ({e!r})")
+            time.sleep(0.5)
+            continue
+        if ev.result_type == wire.RESULT_SUCCESS:
+            if attempt > 1:
+                notes.append(f"{tx_base}: succeeded on attempt {attempt}")
+            return ev
+        last = ev
+        notes.append(f"{tx_id}: ERROR ({ev.error_reason!r}); retrying")
+    raise RuntimeError(
+        f"signing {tx_base!r} failed after {attempts} attempts: "
+        f"{last.error_reason if last else 'no result'}"
+    )
+
+
+def _reshare(cluster: LocalCluster, wallet_id: str,
+             timeout_s: float = 60.0) -> wire.ResharingSuccessEvent:
+    return cluster.reshare_sync(
+        wallet_id, new_threshold=1, key_type=wire.KEY_TYPE_ED25519,
+        timeout_s=timeout_s,
+    )
+
+
+# -- node lifecycle (SIGKILL semantics) --------------------------------------
+
+
+def _stop_heartbeat(node) -> None:
+    """The process is dead: heartbeats stop, the ready key is NOT
+    resigned — peers must detect the death via heartbeat staleness (the
+    registry's change-based liveness), exactly like a real SIGKILL."""
+    reg = node.registry
+    reg._registered = False
+    reg._stop.set()
+
+
+def kill_node(cluster: LocalCluster, node_id: str) -> None:
+    """Crash a node mid-protocol: its transport goes silent both ways
+    and its registry heartbeat stops."""
+    ft = cluster.fault_transports.get(node_id)
+    if ft is None:
+        raise KeyError(
+            f"{node_id!r} has no FaultyTransport — install a fault plan "
+            f"for it (LocalCluster fault_plans)"
+        )
+    _stop_heartbeat(cluster.nodes[node_id])
+    ft.crash_switch.crash()
+
+
+def restart_node(cluster: LocalCluster, node_id: str) -> None:
+    """Bring a crashed node back: transport restored, registry re-arms
+    its heartbeat and watch loop, readiness re-announced."""
+    node = cluster.nodes[node_id]
+    ft = cluster.fault_transports[node_id]
+    ft.crash_switch.restore()
+    reg = node.registry
+    if reg._thread is not None:
+        reg._thread.join(timeout=2.0)
+        reg._thread = None
+    reg._stop = threading.Event()
+    reg.watch()
+    reg.ready()
+
+
+# -- the drills --------------------------------------------------------------
+
+
+def _drill_node_crash(seed: int, scale: float) -> Tuple[str, bool, List[str], dict, dict]:
+    plan = named_plan("node-crash", seed)
+    notes: List[str] = []
+    cluster, root = _mk_cluster({"node2": plan})
+    try:
+        # the crash rule fires inside the transport; SIGKILL semantics
+        # need the heartbeat stopped at the same instant
+        ft = cluster.fault_transports["node2"]
+        ft.crash_switch.on_crash(
+            lambda n=cluster.nodes["node2"]: _stop_heartbeat(n)
+        )
+        _eddsa_keygen(cluster, "w-crash")
+        notes.append("keygen complete on all 3 nodes")
+
+        # tx-c0 triggers the crash: node2 dies the moment it announces
+        # itself in the signing session. The attempt must fail LOUDLY
+        # (bounded ERROR event), never hang.
+        try:
+            ev0 = _sign(cluster, "w-crash", "tx-c0", timeout_s=60.0)
+            loud = ev0.result_type == wire.RESULT_ERROR
+            notes.append(
+                f"tx-c0 under crash: {ev0.result_type} "
+                f"({ev0.error_reason!r})"
+            )
+        except TimeoutError:
+            loud = False
+            notes.append("tx-c0 HUNG — no loud failure within budget")
+        if not ft.crash_switch.crashed:
+            notes.append("crash rule never fired")
+            return "crash-not-triggered", False, notes, plan.to_json(), {}
+
+        # survivors must notice the death (heartbeat staleness) ...
+        survivors = ("node0", "node1")
+        noticed = _wait(
+            lambda: all(
+                not cluster.nodes[n].registry.is_peer_ready("node2")
+                for n in survivors
+            ),
+            timeout_s=15.0,
+        )
+        notes.append(f"death detected by survivors: {noticed}")
+        # ... and sign with t+1 = 2 of 3
+        ev1 = _sign_retrying(cluster, "w-crash", "tx-c1", notes)
+        notes.append("signed with one node down")
+
+        # restart: the node rejoins and the full committee signs again,
+        # then the wallet reshares cleanly on the recovered cluster
+        restart_node(cluster, "node2")
+        rejoined = _wait(
+            lambda: cluster.nodes["node0"].registry.is_peer_ready("node2"),
+            timeout_s=15.0,
+        )
+        notes.append(f"node2 rejoined after restart: {rejoined}")
+        ev2 = _sign_retrying(cluster, "w-crash", "tx-c2", notes)
+        _reshare(cluster, "w-crash")
+        ev3 = _sign_retrying(cluster, "w-crash", "tx-c3", notes)
+        notes.append("post-restart sign + reshare + sign complete")
+
+        ok = (loud and noticed and rejoined
+              and ev1.result_type == wire.RESULT_SUCCESS
+              and ev2.result_type == wire.RESULT_SUCCESS
+              and ev3.result_type == wire.RESULT_SUCCESS)
+        return ("recovered" if ok else "degraded", ok, notes,
+                plan.to_json(), _merged_stats(cluster).to_json())
+    finally:
+        _close(cluster, root)
+
+
+def _drill_drop_jitter(seed: int, scale: float) -> Tuple[str, bool, List[str], dict, dict]:
+    plan = named_plan("drop-jitter", seed, scale=scale)
+    notes: List[str] = []
+    cluster, root = _mk_cluster({"*": plan})
+    try:
+        attempts = _eddsa_keygen(cluster, "w-dj")
+        notes.append(f"keygen complete (attempt {attempts})")
+        for i in range(3):
+            ev = _sign_retrying(cluster, "w-dj", f"tx-dj{i}", notes)
+            assert ev.result_type == wire.RESULT_SUCCESS
+        notes.append("3 signatures under 10% unicast loss + jitter")
+        _reshare(cluster, "w-dj")
+        ev = _sign_retrying(cluster, "w-dj", "tx-dj-post-rs", notes)
+        notes.append("reshare + post-reshare signature complete")
+        stats = _merged_stats(cluster)
+        faults = stats.to_json()
+        notes.append(
+            f"faults injected: {faults['counters']}; "
+            f"unicast losses absorbed by retries: {stats.retries_observed}"
+        )
+        ok = ev.result_type == wire.RESULT_SUCCESS
+        return ("success" if ok else "failed", ok, notes,
+                plan.to_json(), faults)
+    finally:
+        _close(cluster, root)
+
+
+def _drill_broker_failover(seed: int, scale: float) -> Tuple[str, bool, List[str], dict, dict]:
+    plan = named_plan("broker-failover", seed)
+    notes: List[str] = []
+    cluster, root = _mk_cluster(
+        {}, transport="tcp", broker_standby=True, reply_timeout_s=8.0,
+    )
+    try:
+        _eddsa_keygen(cluster, "w-bf")
+        ev = _sign(cluster, "w-bf", "tx-bf0", timeout_s=60.0)
+        assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+        notes.append("keygen + baseline signature over primary broker")
+
+        cluster.broker.close()
+        notes.append("primary broker killed mid-run")
+        # every client walks its address list to the standby and replays
+        # subscriptions; the first post-failover submits can land in a
+        # dead socket buffer, so the client-level retry does the rest
+        ev = _sign_retrying(cluster, "w-bf", "tx-bf1", notes,
+                            attempts=4, timeout_s=30.0)
+        notes.append("signature completed via standby broker")
+        ok = ev.result_type == wire.RESULT_SUCCESS
+        return ("success" if ok else "failed", ok, notes,
+                plan.to_json(), _merged_stats(cluster).to_json())
+    finally:
+        _close(cluster, root)
+
+
+def _drill_partition(seed: int, scale: float) -> Tuple[str, bool, List[str], dict, dict]:
+    plan = named_plan("partition", seed)
+    notes: List[str] = []
+    cluster, root = _mk_cluster(
+        {"*": plan}, hello_timeout_s=3.0, reply_timeout_s=4.0,
+        session_timeout_s=8.0,
+    )
+    try:
+        _eddsa_keygen(cluster, "w-p")
+        ev = _sign(cluster, "w-p", "tx-p0", timeout_s=60.0)
+        assert ev.result_type == wire.RESULT_SUCCESS, ev.error_reason
+        notes.append("keygen + baseline signature pre-partition")
+
+        plan.activate()  # partition node1+node2: over threshold, no quorum
+        t0 = time.monotonic()
+        try:
+            ev1 = _sign(cluster, "w-p", "tx-p1", timeout_s=90.0)
+            loud = ev1.result_type == wire.RESULT_ERROR
+            notes.append(
+                f"tx-p1 under partition: {ev1.result_type} after "
+                f"{time.monotonic() - t0:.1f}s "
+                f"(timeout={getattr(ev1, 'is_timeout', False)}, "
+                f"reason={ev1.error_reason!r})"
+            )
+        except TimeoutError:
+            loud = False
+            notes.append("tx-p1 HUNG under partition — drill failed")
+
+        plan.heal()
+        notes.append("partition healed")
+        ev2 = _sign_retrying(cluster, "w-p", "tx-p2", notes)
+        ok = loud and ev2.result_type == wire.RESULT_SUCCESS
+        notes.append("post-heal signature complete")
+        return ("loud-failure-then-recovery" if ok else "degraded", ok,
+                notes, plan.to_json(), _merged_stats(cluster).to_json())
+    finally:
+        _close(cluster, root)
+
+
+DRILLS: Dict[str, Tuple[Callable, str]] = {
+    "node-crash": (_drill_node_crash, "recovered"),
+    "drop-jitter": (_drill_drop_jitter, "success"),
+    "broker-failover": (_drill_broker_failover, "success"),
+    "partition": (_drill_partition, "loud-failure-then-recovery"),
+}
+
+
+def run_drill(name: str, seed: int = DEFAULT_SEED,
+              scale: float = 1.0) -> DrillReport:
+    """Run one named drill; never raises — failures land in the report."""
+    if name not in DRILLS:
+        raise KeyError(f"unknown drill {name!r}; have {sorted(DRILLS)}")
+    fn, expected = DRILLS[name]
+    t0 = time.monotonic()
+    try:
+        outcome, ok, notes, plan_json, faults = fn(seed, scale)
+        err = ""
+    except Exception as e:  # noqa: BLE001 — report, don't crash the runner
+        outcome, ok, notes, plan_json, faults = "error", False, [], {}, {}
+        err = repr(e)
+    return DrillReport(
+        name=name, seed=seed, expected=expected, outcome=outcome, ok=ok,
+        duration_s=time.monotonic() - t0, plan=plan_json, faults=faults,
+        notes=notes, error=err,
+    )
+
+
+def run_all(seed: int = DEFAULT_SEED, scale: float = 1.0) -> List[DrillReport]:
+    return [run_drill(name, seed=seed, scale=scale) for name in DRILLS]

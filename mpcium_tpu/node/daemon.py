@@ -53,6 +53,7 @@ def run_node(
     decrypt_private_key: bool = False,
     debug: bool = False,
     block: bool = True,
+    fault_plan=None,  # faults.FaultPlan | path to a plan JSON | None
 ):
     cfg = init_config(config_path)
     log.init(
@@ -75,6 +76,20 @@ def run_node(
         encrypt=cfg.broker_encrypt,
         standbys=parse_addrs(cfg.broker_standbys),
     )
+    # chaos seam (ISSUE 3): an explicit plan argument or the
+    # chaos_fault_plan config knob (path to a plan JSON) wraps this
+    # daemon's transport in a FaultyTransport. Absent both — the normal
+    # case — nothing is constructed and the bare transport flows on.
+    fault_plan = fault_plan or (cfg.chaos_fault_plan or None)
+    if fault_plan is not None:
+        from ..faults.plan import FaultPlan
+        from ..faults.transport import FaultyTransport
+
+        if isinstance(fault_plan, (str, Path)):
+            fault_plan = FaultPlan.from_json(Path(fault_plan).read_text())
+        transport = FaultyTransport(transport, name, fault_plan)
+        log.warn("CHAOS: fault plan installed", node=name,
+                 seed=fault_plan.seed, rules=fault_plan.describe())
     if cfg.control_plane == "broker":
         from ..store.broker_kv import BrokerKV
 

@@ -56,6 +56,7 @@ class Node:
         preparams: Optional[PreParams] = None,
         safe_prime_pool: Optional[str] = None,
         min_paillier_bits: int = 2046,
+        hello_timeout_s: Optional[float] = 20.0,
     ):
         self.node_id = node_id
         self.peer_ids = sorted(set(peer_ids) | {node_id})
@@ -65,6 +66,10 @@ class Node:
         self.keyinfo = keyinfo
         self.registry = registry
         self.min_paillier_bits = min_paillier_bits
+        # hello-barrier deadline for every session this node creates;
+        # chaos drills shrink it so partition failures surface inside the
+        # drill budget instead of the default 20 s (session.py:63)
+        self.hello_timeout_s = hello_timeout_s
         # ECDSA pre-params once at startup (reference node.go:69); the pool
         # file makes this seconds instead of minutes
         if preparams is None:
@@ -155,6 +160,7 @@ class Node:
             direct_topic_fn=lambda n: wire.keygen_direct_topic(key_type, n, wallet_id),
             on_done=persist_and_done,
             on_error=on_error,
+            hello_timeout_s=self.hello_timeout_s,
         )
 
     # -- signing ------------------------------------------------------------
@@ -224,6 +230,7 @@ class Node:
             ),
             on_done=on_done,
             on_error=on_error,
+            hello_timeout_s=self.hello_timeout_s,
         )
 
     # -- resharing ----------------------------------------------------------
@@ -313,4 +320,5 @@ class Node:
             direct_topic_fn=lambda n: wire.resharing_direct_topic(key_type, n, wallet_id),
             on_done=persist_and_done,
             on_error=on_error,
+            hello_timeout_s=self.hello_timeout_s,
         )

@@ -19,7 +19,10 @@ import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # bare env: RFC-vector-validated pure-python fallback
+    from ..core.softcrypto import ChaCha20Poly1305
 
 
 class KVStore(abc.ABC):

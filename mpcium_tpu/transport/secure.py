@@ -25,12 +25,21 @@ from __future__ import annotations
 import hashlib
 from typing import Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # bare env: RFC-vector-validated pure-python fallback
+    from ..core.softcrypto import (
+        HKDF,
+        SHA256,
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
 
 
 class ChannelCipher:

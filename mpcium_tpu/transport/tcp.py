@@ -64,7 +64,10 @@ import time
 from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
-from cryptography.exceptions import InvalidTag as _InvalidTag
+try:
+    from cryptography.exceptions import InvalidTag as _InvalidTag
+except ImportError:  # bare env: softcrypto's AEAD raises its own InvalidTag
+    from ..core.softcrypto import InvalidTag as _InvalidTag
 
 from .api import (
     DeadLetterHandler,

@@ -1,0 +1,72 @@
+"""Chaos drills in the test tier (ISSUE 3). The fast deterministic
+drills run in tier-1 under the ``chaos`` marker; the randomized
+multi-seed soak is ``slow``. Every drill here goes through the real
+cluster stack — nodes, consumers, durable queues, registry liveness —
+under an active fault plan."""
+import pytest
+
+from mpcium_tpu.faults.chaos import run_drill
+
+pytestmark = pytest.mark.chaos
+
+
+def _assert_ok(report):
+    assert report.ok, (
+        f"drill {report.name!r} (seed {report.seed}) expected "
+        f"{report.expected!r} got {report.outcome!r}: "
+        f"error={report.error!r} notes={report.notes}"
+    )
+
+
+def test_drill_drop_jitter_fast():
+    """keygen → 3 signatures → reshare → signature under 10% unicast
+    loss + (scaled) jitter: the retry budgets absorb everything."""
+    report = run_drill("drop-jitter", seed=3, scale=0.15)
+    _assert_ok(report)
+    # the plan rode along in the report for reproduction
+    assert report.plan["seed"] == 3 and report.plan["rules"]
+
+
+def test_drill_partition_loud_failure_then_recovery():
+    """Over-threshold partition: signing fails LOUDLY (bounded timeout
+    ERROR event — no hang, no silent corruption) and succeeds after the
+    partition heals."""
+    report = run_drill("partition", seed=5)
+    _assert_ok(report)
+    assert any("error" in n for n in report.notes)
+    # the partition rule actually suppressed traffic
+    assert any(k.startswith("partition") for k in report.faults["counters"])
+
+
+def test_drill_broker_failover():
+    """Primary broker dies mid-run; clients walk to the hot standby."""
+    report = run_drill("broker-failover", seed=13)
+    _assert_ok(report)
+
+
+def test_drill_node_crash_recovers():
+    """node2 SIGKILLs as it joins its first signing session: the tx
+    fails loudly, survivors detect the death and sign with t+1, the
+    restarted node rejoins, and the wallet reshares cleanly."""
+    report = run_drill("node-crash", seed=11)
+    _assert_ok(report)
+    assert report.faults["counters"]["crash_node#0"]["crash"] == 1
+
+
+def test_drill_report_reproducible_from_seed():
+    """Same (drill, seed) ⇒ same outcome and the identical serialized
+    plan — the reproduction contract scripts/chaos_drill.py documents."""
+    a = run_drill("drop-jitter", seed=21, scale=0.15)
+    b = run_drill("drop-jitter", seed=21, scale=0.15)
+    assert (a.outcome, a.ok, a.expected) == (b.outcome, b.ok, b.expected)
+    assert a.plan == b.plan
+
+
+@pytest.mark.slow
+def test_drill_soak_multi_seed():
+    """Randomized soak: the catalog across several seeds at full time
+    scale — any seed that fails is directly reproducible via
+    scripts/chaos_drill.py --plan <name> --seed <seed>."""
+    for seed in range(4):
+        for name in ("drop-jitter", "partition", "broker-failover"):
+            _assert_ok(run_drill(name, seed=seed))

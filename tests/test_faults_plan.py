@@ -1,0 +1,144 @@
+"""Fault-plan determinism (ISSUE 3 satellite): the same (seed, plan)
+produces the identical fault schedule across runs, different seeds
+diverge, retransmissions re-roll, and plans survive JSON round-trips."""
+import pytest
+
+from mpcium_tpu.faults.plan import (
+    FaultPlan,
+    MsgEvent,
+    Rule,
+    crash_node,
+    delay,
+    drop,
+    duplicate,
+    glob_match,
+    named_plan,
+    partition,
+    reorder,
+)
+
+
+def _mk_plan(seed):
+    return FaultPlan(seed, [
+        drop(p=0.5, topic="t:*", channel="direct"),
+        delay(ms=(10.0, 20.0), topic="t:*"),
+    ])
+
+
+def _traffic():
+    return [
+        MsgEvent("out", "direct", f"t:{i % 5}", b"payload-%d" % (i % 7), "nodeX")
+        for i in range(60)
+    ]
+
+
+def _schedule(plan):
+    out = []
+    for ev in _traffic():
+        for r in plan.matching(ev, ("drop", "delay")):
+            u, key, occ = plan.roll(r, ev)
+            entry = (r.rule_id, key.hex(), occ, u < r.p)
+            if r.kind == "delay":
+                entry += (round(plan.delay_ms(r, key, occ), 6),)
+            out.append(entry)
+    return out
+
+
+def test_same_seed_identical_schedule():
+    assert _schedule(_mk_plan(1234)) == _schedule(_mk_plan(1234))
+
+
+def test_different_seed_different_schedule():
+    a, b = _schedule(_mk_plan(1)), _schedule(_mk_plan(2))
+    assert [e[:3] for e in a] == [e[:3] for e in b]  # same judgements...
+    assert a != b  # ...different outcomes
+
+
+def test_retransmission_rerolls():
+    """A retried identical message bumps occurrence and draws fresh —
+    a 100%-unlucky first roll cannot black-hole the message forever."""
+    plan = FaultPlan(99, [drop(p=0.5, topic="x")])
+    rule = plan.rules[0]
+    ev = MsgEvent("out", "direct", "x", b"same-bytes", "n")
+    draws = [plan.roll(rule, ev) for _ in range(32)]
+    occs = [occ for _u, _k, occ in draws]
+    assert occs == list(range(32))  # per-message occurrence counter
+    us = {u for u, _k, _o in draws}
+    assert len(us) > 16  # independent draws, not one sticky verdict
+
+
+def test_delay_bounds():
+    plan = FaultPlan(5, [delay(ms=(50.0, 200.0), topic="*")])
+    rule = plan.rules[0]
+    for i in range(200):
+        ev = MsgEvent("out", "pubsub", f"a:{i}", b"%d" % i, "n")
+        u, key, occ = plan.roll(rule, ev)
+        assert 50.0 <= plan.delay_ms(rule, key, occ) <= 200.0
+
+
+def test_matching_predicates():
+    r = drop(p=1.0, topic="sign:*", node="node1", channel="direct",
+             direction="out")
+    assert r.matches(MsgEvent("out", "direct", "sign:eddsa:x", b"", "node1"))
+    assert not r.matches(MsgEvent("out", "direct", "keygen:x", b"", "node1"))
+    assert not r.matches(MsgEvent("out", "direct", "sign:x", b"", "node2"))
+    assert not r.matches(MsgEvent("out", "pubsub", "sign:x", b"", "node1"))
+    assert not r.matches(MsgEvent("in", "direct", "sign:x", b"", "node1"))
+    assert glob_match("*", "anything") and glob_match("a:*", "a:b:c")
+    assert not glob_match("a:*", "b:a")
+
+
+def test_json_roundtrip_preserves_schedule():
+    plan = named_plan("drop-jitter", seed=42)
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.to_json() == plan.to_json()
+    assert _schedule_all(clone) == _schedule_all(plan)
+
+
+def _schedule_all(plan):
+    out = []
+    for ev in _traffic():
+        for r in plan.matching(ev, ("drop", "delay", "duplicate", "reorder")):
+            out.append((r.rule_id,) + plan.roll(r, ev))
+    return out
+
+
+def test_partition_window():
+    plan = FaultPlan(1, [partition(("n1", "n2"), duration_s=2.0, start_s=1.0)])
+    assert plan.isolated("n1", now=100.0) is None  # dormant until activate
+    plan.activate(now=0.0)
+    assert plan.isolated("n1", now=0.5) is None
+    assert plan.isolated("n1", now=1.5) is not None
+    assert plan.isolated("n3", now=1.5) is None  # not in the partition
+    assert plan.isolated("n2", now=3.5) is None  # window over
+    open_ended = FaultPlan(1, [partition(("n1",))]).activate(now=0.0)
+    assert open_ended.isolated("n1", now=9999.0) is not None
+    open_ended.heal()
+    assert open_ended.isolated("n1", now=9999.0) is None
+
+
+def test_crash_rule_is_one_shot():
+    plan = FaultPlan(1, [crash_node("n2", topic="sign:*")])
+    (rule,) = plan.crash_rules("n2")
+    assert plan.crash_rules("n1") == []
+    plan.mark_fired(rule)
+    assert plan.crash_rules("n2") == []  # a restarted node stays up
+
+
+def test_named_plans_cover_the_catalog():
+    for name in ("drop-jitter", "node-crash", "broker-failover",
+                 "partition", "duplicate-reorder"):
+        p = named_plan(name, seed=3)
+        assert isinstance(p, FaultPlan) and p.seed == 3
+    with pytest.raises(KeyError):
+        named_plan("nope", seed=3)
+
+
+def test_scale_changes_times_not_structure():
+    a = named_plan("drop-jitter", seed=3, scale=1.0)
+    b = named_plan("drop-jitter", seed=3, scale=0.1)
+    assert [r.kind for r in a.rules] == [r.kind for r in b.rules]
+    assert [r.p for r in a.rules] == [r.p for r in b.rules]
+    da = next(r for r in a.rules if r.kind == "delay")
+    db = next(r for r in b.rules if r.kind == "delay")
+    assert db.ms[1] == pytest.approx(da.ms[1] * 0.1)

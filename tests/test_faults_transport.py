@@ -1,0 +1,280 @@
+"""FaultyTransport behavior (ISSUE 3): the empty-plan decorator is
+transcript-identical to the bare transport (zero-overhead seam), each
+fault kind does what it says on the loopback fabric, and the schedule a
+run produces is deterministic for deterministic traffic (the
+bit-exactness style of test_mta_ot_pipeline.py, applied to transcripts)."""
+import threading
+import time
+
+import pytest
+
+from mpcium_tpu.faults.plan import (
+    FaultPlan, crash_node, delay, drop, duplicate, partition, reorder,
+)
+from mpcium_tpu.faults.transport import CrashSwitch, FaultStats, FaultyTransport
+from mpcium_tpu.transport.api import Permanent, TransportError
+from mpcium_tpu.transport.loopback import LoopbackFabric
+
+
+@pytest.fixture()
+def fabric():
+    f = LoopbackFabric()
+    yield f
+    f.close()
+
+
+def _drain(fabric, extra_sleep=0.0):
+    fabric.drain(timeout_s=30)
+    if extra_sleep:
+        time.sleep(extra_sleep)
+        fabric.drain(timeout_s=30)
+
+
+# -- zero-overhead transparency ---------------------------------------------
+
+
+def _transcript(fabric, transport, tag):
+    """Deterministic traffic across all three channels; returns the
+    delivered transcript."""
+    got = {"pubsub": [], "direct": [], "queue": []}
+    bare = fabric.transport()
+    bare.pubsub.subscribe(f"{tag}:ps:*", lambda d: got["pubsub"].append(d))
+    bare.direct.listen(f"{tag}:dm:1", lambda d: got["direct"].append(d))
+    bare.queues.dequeue(f"{tag}:q:*", lambda d: got["queue"].append(d))
+    for i in range(20):
+        transport.pubsub.publish(f"{tag}:ps:{i % 3}", b"ps-%d" % i)
+        transport.direct.send(f"{tag}:dm:1", b"dm-%d" % i)
+        transport.queues.enqueue(f"{tag}:q:{i % 2}", b"q-%d" % i,
+                                 idempotency_key=f"{tag}-{i}")
+        # idempotent replay: must be deduped identically on both paths
+        transport.queues.enqueue(f"{tag}:q:{i % 2}", b"q-%d" % i,
+                                 idempotency_key=f"{tag}-{i}")
+    _drain(fabric)
+    return {k: sorted(v) for k, v in got.items()}
+
+
+def test_empty_plan_is_transcript_identical(fabric):
+    bare = _transcript(fabric, fabric.transport(), "bare")
+    ft = FaultyTransport(fabric.transport(), "nodeA", FaultPlan(7, []))
+    wrapped = _transcript(fabric, ft, "wrap")
+    # identical multiset of delivered bytes on every channel
+    assert bare == wrapped
+    # and the decorator recorded nothing — no PRF draws, no schedule
+    assert ft.stats.to_json() == {
+        "counters": {}, "retries_observed": 0, "events": 0,
+    }
+    assert ft.stats.canonical_schedule() == []
+
+
+def test_subscription_passthrough_unsubscribes(fabric):
+    ft = FaultyTransport(fabric.transport(), "n", FaultPlan(1, []))
+    got = []
+    sub = ft.pubsub.subscribe("s:*", lambda d: got.append(d))
+    ft.pubsub.publish("s:1", b"a")
+    _drain(fabric)
+    sub.unsubscribe()
+    ft.pubsub.publish("s:1", b"b")
+    _drain(fabric)
+    assert got == [b"a"]
+
+
+# -- fault kinds -------------------------------------------------------------
+
+
+def test_drop_on_direct_consumes_retries_then_raises(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [drop(p=1.0, topic="d:*", channel="direct")]),
+    )
+    fabric.transport().direct.listen("d:1", lambda d: None)
+    with pytest.raises(TransportError, match="lost"):
+        ft.direct.send("d:1", b"x")
+    assert ft.stats.retries_observed == 3
+    assert ft.stats.counters["drop#0"]["drop"] == 3
+
+
+def test_drop_on_pubsub_is_true_loss(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [drop(p=1.0, topic="p:*", channel="pubsub")]),
+    )
+    got = []
+    fabric.transport().pubsub.subscribe("p:*", lambda d: got.append(d))
+    ft.pubsub.publish("p:1", b"lost")
+    _drain(fabric)
+    assert got == []
+    assert ft.stats.counters["drop#0"]["drop"] == 1
+
+
+def test_duplicate_queue_without_key_delivers_twice(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [duplicate(p=1.0, topic="q:*", channel="queue")]),
+    )
+    got = []
+    fabric.transport().queues.dequeue("q:*", lambda d: got.append(d))
+    ft.queues.enqueue("q:1", b"payload")  # no idempotency key
+    _drain(fabric)
+    assert got == [b"payload", b"payload"]
+    # WITH a key, the dedup window must absorb the duplicate
+    got.clear()
+    ft.queues.enqueue("q:1", b"keyed", idempotency_key="k1")
+    _drain(fabric)
+    assert got == [b"keyed"]
+
+
+def test_delay_defers_pubsub_delivery(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [delay(ms=(80.0, 120.0), topic="p:*",
+                            channel="pubsub")]),
+    )
+    got = []
+    fabric.transport().pubsub.subscribe("p:*", lambda d: got.append(d))
+    t0 = time.monotonic()
+    ft.pubsub.publish("p:1", b"late")
+    assert time.monotonic() - t0 < 0.05  # publish itself never blocks
+    assert got == []
+    time.sleep(0.2)
+    _drain(fabric)
+    assert got == [b"late"]
+    (entry,) = ft.stats.schedule
+    assert 80.0 <= entry["ms"] <= 120.0
+
+
+def test_reorder_swaps_adjacent_messages(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [reorder(p=1.0, topic="r:*", channel="pubsub")]),
+    )
+    got = []
+    fabric.transport().pubsub.subscribe("r:*", lambda d: got.append(d))
+    ft.pubsub.publish("r:1", b"first")
+    ft.pubsub.publish("r:1", b"second")
+    _drain(fabric, extra_sleep=0.15)
+    assert got == [b"second", b"first"]
+
+
+def test_reorder_flushes_lone_message(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [reorder(p=1.0, topic="r:*", channel="pubsub",
+                              window_ms=50.0)]),
+    )
+    got = []
+    fabric.transport().pubsub.subscribe("r:*", lambda d: got.append(d))
+    ft.pubsub.publish("r:1", b"only")
+    time.sleep(0.15)
+    _drain(fabric)
+    assert got == [b"only"]  # no successor: flushed after the window
+
+
+def test_crash_switch_silences_both_directions(fabric):
+    ft = FaultyTransport(fabric.transport(), "n", FaultPlan(3, []))
+    got_in, got_out = [], []
+    ft.pubsub.subscribe("in:*", lambda d: got_in.append(d))
+    fabric.transport().pubsub.subscribe("out:*", lambda d: got_out.append(d))
+    ft.crash_switch.crash()
+    ft.pubsub.publish("out:1", b"x")  # outbound suppressed
+    fabric.transport().pubsub.publish("in:1", b"y")  # inbound suppressed
+    _drain(fabric)
+    assert got_out == [] and got_in == []
+    assert ft.stats.counters["__crashed__"]["drop"] == 2
+    with pytest.raises(TransportError):
+        ft.direct.send("out:1", b"x")
+    ft.crash_switch.restore()
+    ft.pubsub.publish("out:1", b"alive")
+    fabric.transport().pubsub.publish("in:1", b"alive")
+    _drain(fabric)
+    assert got_out == [b"alive"] and got_in == [b"alive"]
+
+
+def test_crash_rule_fires_on_matching_round(fabric):
+    plan = FaultPlan(3, [crash_node("n2", at_round="r2", topic="sign:*")])
+    ft = FaultyTransport(fabric.transport(), "n2", plan)
+    hooks = []
+    ft.crash_switch.on_crash(lambda: hooks.append("fired"))
+    env_r1 = b'{"round": "r1", "payload": {}}'
+    env_r2 = b'{"round": "r2", "payload": {}}'
+    ft.pubsub.publish("sign:x", env_r1)
+    assert not ft.crash_switch.crashed  # wrong round
+    ft.pubsub.publish("keygen:x", env_r2)
+    assert not ft.crash_switch.crashed  # wrong topic
+    ft.pubsub.publish("sign:x", env_r2)
+    assert ft.crash_switch.crashed and hooks == ["fired"]
+    # one-shot: restoring and re-sending must not re-crash
+    ft.crash_switch.restore()
+    ft.pubsub.publish("sign:x", env_r2)
+    assert not ft.crash_switch.crashed
+
+
+def test_partition_isolates_listed_nodes(fabric):
+    plan = FaultPlan(3, [partition(("n1",))])
+    ft1 = FaultyTransport(fabric.transport(), "n1", plan)
+    ft2 = FaultyTransport(fabric.transport(), "n2", plan)
+    got = []
+    fabric.transport().pubsub.subscribe("t:*", lambda d: got.append(d))
+    plan.activate()
+    ft1.pubsub.publish("t:1", b"from-isolated")
+    ft2.pubsub.publish("t:1", b"from-connected")
+    _drain(fabric)
+    assert got == [b"from-connected"]
+    plan.heal()
+    ft1.pubsub.publish("t:1", b"healed")
+    _drain(fabric)
+    assert got == [b"from-connected", b"healed"]
+
+
+# -- deterministic transcripts ----------------------------------------------
+
+
+def _run_faulty_transcript(seed):
+    fabric = LoopbackFabric()
+    try:
+        plan = FaultPlan(seed, [
+            drop(p=0.4, topic="t:*", channel="pubsub"),
+            drop(p=0.4, topic="t:*", channel="direct"),
+        ])
+        ft = FaultyTransport(fabric.transport(), "n", plan)
+        got = []
+        bare = fabric.transport()
+        bare.pubsub.subscribe("t:*", lambda d: got.append(d))
+        bare.direct.listen("t:dm", lambda d: got.append(d))
+        for i in range(40):
+            ft.pubsub.publish(f"t:{i % 4}", b"m-%d" % i)
+        for i in range(10):
+            try:
+                ft.direct.send("t:dm", b"d-%d" % i)
+            except TransportError:
+                pass  # triple loss — deterministic per seed
+        fabric.drain(timeout_s=30)
+        return sorted(got), ft.stats.canonical_schedule()
+    finally:
+        fabric.close()
+
+
+def test_faulty_transcript_deterministic_across_runs():
+    """Same (seed, plan, traffic) ⇒ identical delivered transcript AND
+    identical fault schedule; a different seed diverges."""
+    got_a, sched_a = _run_faulty_transcript(17)
+    got_b, sched_b = _run_faulty_transcript(17)
+    assert got_a == got_b
+    assert sched_a == sched_b
+    got_c, sched_c = _run_faulty_transcript(18)
+    assert sched_c != sched_a
+
+
+def test_stats_merge():
+    a, b = FaultStats(), FaultStats()
+    from mpcium_tpu.faults.plan import MsgEvent
+
+    ev = MsgEvent("out", "pubsub", "t", b"x", "n")
+    a.record("r1", "drop", ev)
+    b.record("r1", "drop", ev)
+    b.record("r2", "delay", ev, ms=12.0)
+    b.retry()
+    merged = FaultStats().merge(a).merge(b)
+    assert merged.counters["r1"]["drop"] == 2
+    assert merged.counters["r2"]["delay"] == 1
+    assert merged.retries_observed == 1
+    assert len(merged.canonical_schedule()) == 3

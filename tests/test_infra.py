@@ -96,9 +96,18 @@ def test_initiator_key_roundtrip(tmp_path):
     assert k.public_bytes == k2.public_bytes
     m = wire.GenerateKeyMessage("w1")
     sig = k.sign(m.raw())
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+    # independent verifier: OpenSSL when available, else the repo's
+    # RFC-8032 hostmath implementation (NOT the identity layer under test)
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
 
-    Ed25519PublicKey.from_public_bytes(k.public_bytes).verify(sig, m.raw())
+        Ed25519PublicKey.from_public_bytes(k.public_bytes).verify(sig, m.raw())
+    except ImportError:
+        from mpcium_tpu.core.hostmath import ed25519_verify
+
+        assert ed25519_verify(k.public_bytes, m.raw(), sig)
 
 
 # -- loopback transport -----------------------------------------------------

@@ -1,0 +1,168 @@
+"""RFC vectors for the pure-python `cryptography` fallback
+(mpcium_tpu/core/softcrypto.py) plus interop sanity for the modules that
+consume it. These run regardless of whether OpenSSL's `cryptography` is
+installed — the fallback must stay correct even when it is dormant."""
+import pytest
+
+from mpcium_tpu.core import softcrypto as sc
+
+
+# -- ChaCha20-Poly1305 (RFC 8439) -------------------------------------------
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    # RFC 8439 §2.8.2 AEAD test vector
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = sc.ChaCha20Poly1305(key).encrypt(nonce, plaintext, aad)
+    assert ct[:-16] == bytes.fromhex(
+        "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+        "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+        "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+        "3ff4def08e4b7a9de576d26586cec64b6116"
+    )
+    assert ct[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert sc.ChaCha20Poly1305(key).decrypt(nonce, ct, aad) == plaintext
+
+
+def test_chacha20poly1305_tamper_raises_invalidtag():
+    key = b"\x01" * 32
+    nonce = b"\x02" * 12
+    ct = bytearray(sc.ChaCha20Poly1305(key).encrypt(nonce, b"secret", b"ad"))
+    ct[0] ^= 1
+    with pytest.raises(sc.InvalidTag):
+        sc.ChaCha20Poly1305(key).decrypt(nonce, bytes(ct), b"ad")
+    # wrong AAD also fails authentication
+    ct = sc.ChaCha20Poly1305(key).encrypt(nonce, b"secret", b"ad")
+    with pytest.raises(sc.InvalidTag):
+        sc.ChaCha20Poly1305(key).decrypt(nonce, ct, b"other")
+
+
+def test_poly1305_rfc8439_vector():
+    # RFC 8439 §2.5.2
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a80103808afb0db2fd4abff6af4149f51b"
+    )
+    tag = sc._poly1305(key, b"Cryptographic Forum Research Group")
+    assert tag == bytes.fromhex("a8061dc1305136c6c22b8baf0c0127a9")
+
+
+# -- X25519 (RFC 7748) -------------------------------------------------------
+
+
+def test_x25519_rfc7748_vector():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    assert sc._x25519_scalarmult(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+
+
+def test_x25519_dh_agreement():
+    # RFC 7748 §6.1 Diffie-Hellman vector
+    a = sc.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    ))
+    b = sc.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    ))
+    assert a.public_key().public_bytes_raw() == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    assert a.exchange(b.public_key()) == shared
+    assert b.exchange(a.public_key()) == shared
+
+
+# -- Ed25519 (RFC 8032) ------------------------------------------------------
+
+
+def test_ed25519_rfc8032_vector():
+    # RFC 8032 §7.1 TEST 2 (one-byte message)
+    sk = sc.Ed25519PrivateKey.from_private_bytes(bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+    ))
+    pub = sk.public_key().public_bytes_raw()
+    assert pub == bytes.fromhex(
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+    )
+    sig = sk.sign(b"\x72")
+    assert sig == bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+    )
+    sk.public_key().verify(sig, b"\x72")
+    with pytest.raises(sc.InvalidSignature):
+        sk.public_key().verify(sig, b"\x73")
+
+
+# -- HKDF-SHA256 (RFC 5869) --------------------------------------------------
+
+
+def test_hkdf_rfc5869_case1():
+    okm = sc.HKDF(
+        algorithm=sc.SHA256(), length=42,
+        salt=bytes.fromhex("000102030405060708090a0b0c"),
+        info=bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"),
+    ).derive(b"\x0b" * 22)
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865"
+    )
+
+
+# -- interop through the consuming modules -----------------------------------
+
+
+def test_identity_roundtrip_on_fallback(tmp_path):
+    """generate_identity → IdentityStore → envelope sign/verify works with
+    whichever backend is active."""
+    from mpcium_tpu.identity.identity import IdentityStore, generate_identity
+    from mpcium_tpu.wire import Envelope
+
+    for nid in ("a", "b"):
+        generate_identity(nid, tmp_path)
+    store_a = IdentityStore(tmp_path, "a", {"a": "a", "b": "b"})
+    store_b = IdentityStore(tmp_path, "b", {"a": "a", "b": "b"})
+    env = Envelope(session_id="s", round="r1", from_id="a", payload={"x": 1})
+    store_a.sign_envelope(env)
+    assert store_b.verify_envelope(env)
+    env.payload["x"] = 2
+    assert not store_b.verify_envelope(env)
+
+
+def test_encrypted_kv_roundtrip_on_fallback(tmp_path):
+    from mpcium_tpu.store.kvstore import EncryptedFileKV
+
+    kv = EncryptedFileKV(tmp_path / "kv", "pw")
+    kv.put("ecdsa:w1", b"share-bytes")
+    assert kv.get("ecdsa:w1") == b"share-bytes"
+    # wrong password fails loudly
+    with pytest.raises(ValueError):
+        EncryptedFileKV(tmp_path / "kv", "other")
+
+
+def test_secure_channel_roundtrip_on_fallback():
+    from mpcium_tpu.transport import secure
+
+    c_priv, c_pub = secure.fresh_keypair()
+    s_priv, s_pub = secure.fresh_keypair()
+    client = secure.derive_cipher(c_priv, s_pub, c_pub, s_pub, "tok", False)
+    server = secure.derive_cipher(s_priv, c_pub, c_pub, s_pub, "tok", True)
+    assert server.decrypt(client.encrypt(b"hello")) == b"hello"
+    assert client.decrypt(server.encrypt(b"world")) == b"world"
+    # wrong token ⇒ different keys ⇒ auth failure
+    mitm = secure.derive_cipher(s_priv, c_pub, c_pub, s_pub, "bad", True)
+    with pytest.raises(Exception):
+        mitm.decrypt(client.encrypt(b"hello"))
