@@ -132,6 +132,7 @@ class LocalCluster(SyncOps):
         hello_timeout_s: Optional[float] = 20.0,
         session_timeout_s: Optional[float] = None,  # EventConsumer GC knobs
         gc_interval_s: Optional[float] = None,  # (chaos drills shrink both)
+        session_wal: bool = False,  # encrypted per-round WAL + crash resume
     ):
         from .config import init_config
 
@@ -167,6 +168,7 @@ class LocalCluster(SyncOps):
         # behavior is byte-identical to a bare cluster
         self._fault_plans = fault_plans or {}
         self.fault_transports: Dict[str, object] = {}
+        self._retired_fault_transports: List[object] = []
         self._hello_timeout_s = hello_timeout_s
         self.control_kv = MemoryKV()  # the Consul analogue
 
@@ -176,59 +178,99 @@ class LocalCluster(SyncOps):
             generate_identity(nid, ident_dir)
         self.initiator = InitiatorKey.generate()
 
-        peers = {nid: nid for nid in self.node_ids}
+        # per-node ctor state, retained so respawn_node() can rebuild a
+        # killed node's runtime stack over its surviving on-disk state
+        self._ident_dir = ident_dir
+        self._peers = {nid: nid for nid in self.node_ids}
+        self._store_password = store_password
+        self._min_paillier_bits = min_paillier_bits
+        self._preparams = preparams or {}
+        self._session_wal = session_wal
+        self._batch_signing = batch_signing
+        self._batch_window_s = batch_window_s
+        self._reply_timeout_s = reply_timeout_s
+        self._ec_kw: Dict[str, float] = {}
+        if session_timeout_s is not None:
+            self._ec_kw["session_timeout_s"] = session_timeout_s
+        if gc_interval_s is not None:
+            self._ec_kw["gc_interval_s"] = gc_interval_s
+
         self.nodes: Dict[str, Node] = {}
         self.consumers: List[EventConsumer] = []
         self.signing_consumers: List[SigningConsumer] = []
-        if preparams is None:
-            preparams = {}
+        self.node_consumers: Dict[str, EventConsumer] = {}
         for nid in self.node_ids:
-            identity = IdentityStore(
-                ident_dir, nid, peers,
-                initiator_pubkey=self.initiator.public_bytes,
-            )
-            kv = EncryptedFileKV(self.root / "db" / nid, store_password)
-            registry = PeerRegistry(
-                nid, self.node_ids, self.control_kv, poll_interval_s=0.05
-            )
-            transport = self._wrap_faults(nid, self._mk_transport())
-            node = Node(
-                node_id=nid,
-                peer_ids=self.node_ids,
-                transport=transport,
-                identity=identity,
-                kvstore=kv,
-                keyinfo=KeyinfoStore(self.control_kv),
-                registry=registry,
-                preparams=preparams.get(nid),
-                min_paillier_bits=min_paillier_bits,
-                hello_timeout_s=self._hello_timeout_s,
-            )
-            self.nodes[nid] = node
-            ec_kw = {}
-            if session_timeout_s is not None:
-                ec_kw["session_timeout_s"] = session_timeout_s
-            if gc_interval_s is not None:
-                ec_kw["gc_interval_s"] = gc_interval_s
-            ec = EventConsumer(
-                node, transport,
-                batch_signing=batch_signing,
-                batch_window_s=batch_window_s,
-                **ec_kw,
-            )
-            ec.run()
-            self.consumers.append(ec)
-            sc = SigningConsumer(transport, reply_timeout_s=reply_timeout_s)
-            sc.run()
-            self.signing_consumers.append(sc)
-            TimeoutConsumer(transport).run()
-            registry.ready()
+            self._spawn_node(nid)
         for node in self.nodes.values():
             assert node.registry.wait_all_ready(10), "cluster failed to form"
         log.info("local cluster ready", nodes=n_nodes, threshold=threshold)
         self.client = MPCClient(
             self._wrap_faults("client", self._mk_transport()), self.initiator
         )
+
+    def _spawn_node(self, nid: str) -> EventConsumer:
+        """Build one node's full runtime stack — identity, encrypted share
+        store (at its canonical on-disk path), optional session-WAL store,
+        registry, transport, Node, consumers — exactly the daemon boot
+        sequence. Used at cluster construction and by :meth:`respawn_node`."""
+        identity = IdentityStore(
+            self._ident_dir, nid, self._peers,
+            initiator_pubkey=self.initiator.public_bytes,
+        )
+        kv = EncryptedFileKV(self.root / "db" / nid, self._store_password)
+        wal = None
+        if self._session_wal:
+            from .store.session_wal import SessionWALStore
+
+            wal = SessionWALStore(kv)
+        registry = PeerRegistry(
+            nid, self.node_ids, self.control_kv, poll_interval_s=0.05
+        )
+        transport = self._wrap_faults(nid, self._mk_transport())
+        node = Node(
+            node_id=nid,
+            peer_ids=self.node_ids,
+            transport=transport,
+            identity=identity,
+            kvstore=kv,
+            keyinfo=KeyinfoStore(self.control_kv),
+            registry=registry,
+            preparams=self._preparams.get(nid),
+            min_paillier_bits=self._min_paillier_bits,
+            hello_timeout_s=self._hello_timeout_s,
+            session_wal=wal,
+        )
+        self.nodes[nid] = node
+        ec = EventConsumer(
+            node, transport,
+            batch_signing=self._batch_signing,
+            batch_window_s=self._batch_window_s,
+            **self._ec_kw,
+        )
+        ec.run()
+        self.consumers.append(ec)
+        self.node_consumers[nid] = ec
+        sc = SigningConsumer(transport, reply_timeout_s=self._reply_timeout_s)
+        sc.run()
+        self.signing_consumers.append(sc)
+        TimeoutConsumer(transport).run()
+        registry.ready()
+        return ec
+
+    def respawn_node(self, node_id: str) -> EventConsumer:
+        """In-process 'restart after SIGKILL': rebuild ``node_id``'s entire
+        runtime over its surviving on-disk state (identity keys, encrypted
+        share store, session WALs) the way a fresh daemon boot would, then
+        replay incomplete WAL sessions. The dead incarnation's objects are
+        deliberately left in place — a killed process never cleans up; its
+        crashed transport keeps black-holing whatever still reaches it."""
+        old_ft = self.fault_transports.pop(node_id, None)
+        if old_ft is not None:
+            self._retired_fault_transports.append(old_ft)
+        ec = self._spawn_node(node_id)
+        # boot-time crash recovery, after ready() — mirrors daemon.run_node
+        ec.resume_incomplete()
+        return ec
 
     def _wrap_faults(self, owner: str, transport):
         """Wrap ``transport`` in a FaultyTransport when a fault plan is
@@ -247,12 +289,16 @@ class LocalCluster(SyncOps):
 
     def close(self) -> None:
         for ec in self.consumers:
-            ec.close()
+            try:
+                ec.close()
+            except Exception as e:  # noqa: BLE001 — dead incarnations may
+                log.warn("consumer close failed", error=repr(e))  # throw
         for sc in self.signing_consumers:
             sc.close()
         for node in self.nodes.values():
             node.registry.resign()
-        for ft in self.fault_transports.values():
+        for ft in list(self.fault_transports.values()) + \
+                self._retired_fault_transports:
             ft.close()
         if self.fabric is not None:
             self.fabric.close()

@@ -40,6 +40,7 @@ class AppConfig:
     batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
     batch_window_s: float = 0.05
     chaos_fault_plan: str = ""  # path to a faults.FaultPlan JSON ("" = off)
+    session_wal: bool = False  # encrypted per-round session WAL + crash resume
     peers_file: str = "peers.json"
 
     def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:

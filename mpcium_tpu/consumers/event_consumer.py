@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from types import SimpleNamespace
 from typing import Dict, Optional
 
 from .. import wire
@@ -93,10 +94,226 @@ class EventConsumer:
         for s in self._subs:
             s.unsubscribe()
         with self._lock:
-            for sessions in self._sessions.values():
-                for s in sessions:
-                    s.close()
+            doomed = [s for ss in self._sessions.values() for s in ss]
             self._sessions.clear()
+            self._claim_ts.clear()
+            self._claim_meta.clear()
+        # close OUTSIDE the lock: closing an unfinished session fires its
+        # on_error callback, which may re-enter our bookkeeping
+        for s in doomed:
+            s.close()
+
+    # -- crash recovery (boot-time WAL resume) ------------------------------
+
+    def resume_incomplete(self) -> int:
+        """Rebuild every incomplete WAL session at daemon boot: restore the
+        party at its last checkpoint, re-attach it to its dedup claim (so
+        queue redeliveries of the originating event get a WIP answer instead
+        of spawning a conflicting duplicate run), and re-join the wire via
+        the session's resume replay. Returns the number of resumed sessions."""
+        wal = self.node.session_wal
+        if wal is None:
+            return 0
+        keygen_reps: Dict[str, list] = {}
+        others = []
+        for rep in wal.incomplete():
+            if rep.meta.get("kind") == "keygen":
+                # the two curves of one wallet share a dedup claim and a
+                # single success event — resume them as a unit
+                keygen_reps.setdefault(rep.meta["wallet_id"], []).append(rep)
+            else:
+                others.append(rep)
+        n = 0
+        for wallet_id, reps in keygen_reps.items():
+            n += self._try_resume(
+                reps, lambda: self._resume_keygen(wallet_id, reps)
+            )
+        for rep in others:
+            if rep.meta.get("kind") == "sign":
+                n += self._try_resume([rep], lambda r=rep: self._resume_sign(r))
+            elif rep.meta.get("kind") == "reshare":
+                n += self._try_resume(
+                    [rep], lambda r=rep: self._resume_reshare(r)
+                )
+            else:
+                log.warn("unknown WAL kind — dropping",
+                         session=rep.session_id, kind=rep.meta.get("kind"))
+                wal.drop(rep.session_id)
+        if n:
+            log.info("crash recovery: sessions resumed", node=self.node.node_id,
+                     count=n)
+        return n
+
+    def _try_resume(self, reps, fn) -> int:
+        try:
+            return int(bool(fn()))
+        except Exception as e:  # noqa: BLE001
+            # unresumable (share/keyinfo missing, snapshot mismatch, ...):
+            # drop the journal so boot never loops on it; the originating
+            # event's redelivery path still provides the retry
+            log.warn("session resume failed — dropping WAL",
+                     sessions=[r.session_id for r in reps], error=repr(e))
+            for r in reps:
+                self.node.session_wal.drop(r.session_id)
+            return 0
+
+    def _resume_keygen(self, wallet_id: str, reps) -> bool:
+        dedup = f"keygen-{wallet_id}"
+        if not self._claim(dedup):
+            return False
+        state = {"left": len(reps)}
+        slock = threading.Lock()
+
+        def finalize():
+            try:
+                infos = {
+                    kt: self.node.keyinfo.get(kt, wallet_id)
+                    for kt in (wire.KEY_TYPE_SECP256K1, wire.KEY_TYPE_ED25519)
+                }
+                if all(i is not None and i.public_key for i in infos.values()):
+                    ev = wire.KeygenSuccessEvent(
+                        wallet_id=wallet_id,
+                        ecdsa_pub_key=infos[wire.KEY_TYPE_SECP256K1].public_key,
+                        eddsa_pub_key=infos[wire.KEY_TYPE_ED25519].public_key,
+                    )
+                    self.transport.queues.enqueue(
+                        f"{wire.TOPIC_KEYGEN_RESULT}.{wallet_id}",
+                        wire.canonical_json(ev.to_json()),
+                        idempotency_key=wallet_id,
+                    )
+                    log.info("wallet created (resumed)", wallet=wallet_id,
+                             node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        def step():
+            with slock:
+                state["left"] -= 1
+                last = state["left"] <= 0
+            if last:
+                finalize()
+
+        def on_done(_share):
+            step()
+
+        def on_error(e):
+            log.warn("resumed keygen failed", wallet=wallet_id, error=str(e))
+            step()
+
+        sessions = [
+            self.node.resume_session(rep, on_done=on_done, on_error=on_error)
+            for rep in reps
+        ]
+        self._track(dedup, sessions)
+        for s in sessions:
+            s.listen()
+        return True
+
+    def _resume_sign(self, rep) -> bool:
+        meta = rep.meta
+        wallet_id, tx_id = meta["wallet_id"], meta["tx_id"]
+        key_type = meta["key_type"]
+        nic = meta.get("network_internal_code", "")
+        dedup = f"{wallet_id}-{tx_id}"
+        fake_msg = SimpleNamespace(
+            wallet_id=wallet_id, tx_id=tx_id, network_internal_code=nic
+        )
+        if not self._claim(dedup, meta=("sign", fake_msg)):
+            return False
+
+        def on_done(result):
+            try:
+                if key_type == wire.KEY_TYPE_SECP256K1:
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=wallet_id,
+                        tx_id=tx_id,
+                        network_internal_code=nic,
+                        r=format(result["r"], "x"),
+                        s=format(result["s"], "x"),
+                        signature_recovery=format(result["recovery"], "02x"),
+                    )
+                else:
+                    ev = wire.SigningResultEvent(
+                        result_type=wire.RESULT_SUCCESS,
+                        wallet_id=wallet_id,
+                        tx_id=tx_id,
+                        network_internal_code=nic,
+                        signature=result.hex(),
+                    )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_SIGNING_RESULT}.{tx_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=tx_id,
+                )
+                log.info("tx signed (resumed)", wallet=wallet_id, tx=tx_id,
+                         node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        def on_error(e):
+            if not isinstance(e, RetryableSessionError):
+                ev = wire.SigningResultEvent(
+                    result_type=wire.RESULT_ERROR,
+                    wallet_id=wallet_id,
+                    tx_id=tx_id,
+                    network_internal_code=nic,
+                    error_reason=str(e),
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_SIGNING_RESULT}.{tx_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=tx_id,
+                )
+            else:
+                log.warn("resumed signing retryable failure",
+                         wallet=wallet_id, tx=tx_id, reason=str(e))
+            self._finish(dedup)
+
+        session = self.node.resume_session(rep, on_done=on_done,
+                                           on_error=on_error)
+        self._track(dedup, [session])
+        session.listen()
+        return True
+
+    def _resume_reshare(self, rep) -> bool:
+        meta = rep.meta
+        wallet_id, key_type = meta["wallet_id"], meta["key_type"]
+        new_threshold = meta["new_threshold"]
+        dedup = f"reshare-{key_type}-{wallet_id}"
+        if not self._claim(dedup):
+            return False
+
+        def on_done(share):
+            try:
+                if share is None:
+                    return  # old-only member
+                ev = wire.ResharingSuccessEvent(
+                    wallet_id=wallet_id,
+                    new_threshold=new_threshold,
+                    key_type=key_type,
+                    pub_key=share.public_key.hex(),
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_RESHARING_RESULT}.{wallet_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=f"{wallet_id}-{key_type}",
+                )
+                log.info("wallet reshared (resumed)", wallet=wallet_id,
+                         key_type=key_type, node=self.node.node_id)
+            finally:
+                self._finish(dedup)
+
+        def on_error(e):
+            log.error("resumed resharing failed", wallet=wallet_id,
+                      error=str(e))
+            self._finish(dedup)
+
+        session = self.node.resume_session(rep, on_done=on_done,
+                                           on_error=on_error)
+        self._track(dedup, [session])
+        session.listen()
+        return True
 
     # -- keygen -------------------------------------------------------------
 
@@ -327,6 +544,7 @@ class EventConsumer:
             session = self.node.create_signing_session(
                 msg.key_type, msg.wallet_id, msg.tx_id, msg.tx,
                 on_done=on_done, on_error=on_error,
+                network_internal_code=msg.network_internal_code,
             )
         except NotEnoughParticipants as e:
             # no reply ⇒ the durable bridge times out, naks, and the queue
@@ -518,13 +736,15 @@ class EventConsumer:
                             > self.session_timeout_s
                         )
                     if reap:
-                        stale.append((key, self._claim_meta.get(key)))
-                        for s in sessions:
-                            s.close()
-                        del self._sessions[key]
+                        stale.append((key, self._claim_meta.get(key), sessions))
+                        self._sessions.pop(key, None)
                         self._claim_ts.pop(key, None)
                         self._claim_meta.pop(key, None)
-            for key, meta in stale:
+            for key, meta, sessions in stale:
+                # close OUTSIDE the lock: an unfinished session's close
+                # fires on_error, which re-enters our bookkeeping
+                for s in sessions:
+                    s.close()
                 log.warn("stale session reaped", key=key,
                          node=self.node.node_id)
                 # a reaped SIGNING claim must surface to the client: WIP

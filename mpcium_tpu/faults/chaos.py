@@ -22,6 +22,11 @@ Drill catalog (expected outcome in parentheses):
   isolated (over threshold: no quorum can form anywhere); signing fails
   loudly and retryably — a bounded timeout ERROR event, no hang, no
   silent corruption — and succeeds after the partition heals.
+- ``kill-resume`` (resumed) — with the session WAL on, node2 SIGKILLs
+  mid-round-2 of a signing session; the survivors stall (the quorum
+  includes the corpse), the node respawns over its on-disk state, WAL
+  replay re-claims the session and the SAME run completes with the
+  bit-identical signature; the report carries ``resume_latency_s``.
 
 Reproducing a failed drill: the report carries ``seed`` and the full
 plan JSON; ``scripts/chaos_drill.py --plan <name> --seed <seed>`` reruns
@@ -57,6 +62,8 @@ class DrillReport:
     faults: dict = field(default_factory=dict)
     notes: List[str] = field(default_factory=list)
     error: str = ""
+    # kill-resume: wall time from respawn to the resumed session's result
+    resume_latency_s: float = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -70,6 +77,7 @@ class DrillReport:
             "faults": self.faults,
             "notes": self.notes,
             "error": self.error,
+            "resume_latency_s": round(self.resume_latency_s, 3),
         }
 
 
@@ -92,7 +100,8 @@ def _mk_cluster(fault_plans: Optional[Dict[str, FaultPlan]] = None,
                 hello_timeout_s: float = 4.0,
                 reply_timeout_s: float = 6.0,
                 session_timeout_s: float = 12.0,
-                gc_interval_s: float = 1.0) -> Tuple[LocalCluster, str]:
+                gc_interval_s: float = 1.0,
+                session_wal: bool = False) -> Tuple[LocalCluster, str]:
     """A 3-node t=1 drill cluster with tightened failure deadlines, so
     loud failures surface inside the drill budget instead of the
     production 30-minute GC."""
@@ -109,6 +118,7 @@ def _mk_cluster(fault_plans: Optional[Dict[str, FaultPlan]] = None,
         reply_timeout_s=reply_timeout_s,
         session_timeout_s=session_timeout_s,
         gc_interval_s=gc_interval_s,
+        session_wal=session_wal,
     )
     return cluster, root
 
@@ -122,7 +132,8 @@ def _close(cluster: LocalCluster, root: str) -> None:
 
 def _merged_stats(cluster: LocalCluster) -> FaultStats:
     merged = FaultStats()
-    for ft in cluster.fault_transports.values():
+    retired = getattr(cluster, "_retired_fault_transports", [])
+    for ft in list(cluster.fault_transports.values()) + list(retired):
         merged.merge(ft.stats)
     return merged
 
@@ -422,11 +433,133 @@ def _drill_partition(seed: int, scale: float) -> Tuple[str, bool, List[str], dic
         _close(cluster, root)
 
 
+def _drill_kill_resume(seed: int, scale: float):
+    """SIGKILL mid-round-2, restart, SAME session completes.
+
+    node2's fault plan crashes it the instant its round-2 decommitment
+    broadcast leaves (the WAL already holds the round-2 checkpoint —
+    checkpoint-before-route). Survivors stall: the signing quorum includes
+    the corpse, so no 2-of-3 fallback exists for THIS session. The drill
+    then respawns node2 over its surviving on-disk state; boot-time WAL
+    replay must re-claim the session, answer the ``__resume__`` handshake
+    and finish with the bit-identical signature on every node.
+    """
+    from ..core import hostmath as hm
+    from .plan import crash_node
+
+    plan = FaultPlan(
+        seed, [crash_node("node2", at_round="eddsa/sign/2", topic="sign:*")]
+    )
+    notes: List[str] = []
+    cluster, root = _mk_cluster({"node2": plan}, session_wal=True)
+    try:
+        ft = cluster.fault_transports["node2"]
+        ft.crash_switch.on_crash(
+            lambda n=cluster.nodes["node2"]: _stop_heartbeat(n)
+        )
+        _eddsa_keygen(cluster, "w-kr")
+        notes.append("keygen complete on all 3 nodes")
+        pub = bytes.fromhex(
+            cluster.nodes["node0"].keyinfo
+            .get(wire.KEY_TYPE_ED25519, "w-kr").public_key
+        )
+
+        box: dict = {}
+
+        def signer():
+            try:
+                box["ev"] = _sign(cluster, "w-kr", "tx-kr0", timeout_s=90.0)
+            except Exception as e:  # noqa: BLE001 — surfaced via the box
+                box["err"] = e
+            box["t_done"] = time.monotonic()
+
+        th = threading.Thread(target=signer, daemon=True)
+        th.start()
+
+        if not _wait(lambda: ft.crash_switch.crashed, timeout_s=30.0):
+            notes.append("crash rule never fired")
+            return "crash-not-triggered", False, notes, plan.to_json(), {}
+        notes.append("node2 SIGKILLed on its round-2 broadcast")
+
+        # hold the survivors' stalled Session objects so their in-memory
+        # results can be compared bit-for-bit after recovery
+        dedup = "w-kr-tx-kr0"
+        held: Dict[str, object] = {}
+        for nid in ("node0", "node1"):
+            ec = cluster.node_consumers[nid]
+            with ec._lock:
+                ss = list(ec._sessions.get(dedup) or [])
+            if ss:
+                held[nid] = ss[0]
+        stalled = len(held) == 2 and all(not s.done for s in held.values())
+        notes.append(f"survivor sessions stalled mid-round: {stalled}")
+
+        time.sleep(0.5)  # everything node2 says next must be WAL replay
+        t_respawn = time.monotonic()
+        new_ec = cluster.respawn_node("node2")
+        with new_ec._lock:
+            ss = list(new_ec._sessions.get(dedup) or [])
+        if ss:
+            held["node2"] = ss[0]
+        notes.append(f"node2 respawned; WAL session re-claimed: {bool(ss)}")
+
+        th.join(90.0)
+        faults = _merged_stats(cluster).to_json()
+        if "ev" not in box:
+            notes.append(
+                f"signing never completed after respawn "
+                f"({box.get('err')!r})"
+            )
+            return "hung", False, notes, plan.to_json(), faults
+        ev = box["ev"]
+        resume_latency = box["t_done"] - t_respawn
+        notes.append(
+            f"tx-kr0: {ev.result_type} {resume_latency:.2f}s after respawn"
+        )
+        sig_ok = (
+            ev.result_type == wire.RESULT_SUCCESS
+            and hm.ed25519_verify(
+                pub, b"chaos:tx-kr0", bytes.fromhex(ev.signature)
+            )
+        )
+        notes.append(f"signature verifies under the wallet key: {sig_ok}")
+        # the client event comes from whichever node finished FIRST (the
+        # per-tx result queue dedups the rest) — give the other parties a
+        # beat to cross their own finish line before comparing bytes
+        _wait(lambda: all(s.done for s in held.values()), timeout_s=10.0)
+        results = {
+            nid: s.party.result.hex()
+            for nid, s in held.items()
+            if s.party.result is not None
+        }
+        identical = (
+            len(results) == 3
+            and len(set(results.values())) == 1
+            and ev.signature in results.values()
+        )
+        notes.append(
+            f"bit-identical signature on {sorted(results)}: {identical}"
+        )
+        # the result event fires from on_done, which runs BEFORE the WAL
+        # drop in Session._finish — poll instead of instant-checking
+        wal_drained = _wait(
+            lambda: not cluster.nodes["node2"].session_wal.incomplete(),
+            timeout_s=5.0,
+        )
+        notes.append(f"node2 WAL drained after completion: {wal_drained}")
+        ok = stalled and sig_ok and identical and wal_drained
+        return ("resumed" if ok else "degraded", ok, notes, plan.to_json(),
+                faults, {"resume_latency_s": resume_latency})
+    finally:
+        _close(cluster, root)
+
+
 DRILLS: Dict[str, Tuple[Callable, str]] = {
     "node-crash": (_drill_node_crash, "recovered"),
     "drop-jitter": (_drill_drop_jitter, "success"),
     "broker-failover": (_drill_broker_failover, "success"),
     "partition": (_drill_partition, "loud-failure-then-recovery"),
+    "kill-resume": (_drill_kill_resume, "resumed"),
 }
 
 
@@ -437,8 +570,12 @@ def run_drill(name: str, seed: int = DEFAULT_SEED,
         raise KeyError(f"unknown drill {name!r}; have {sorted(DRILLS)}")
     fn, expected = DRILLS[name]
     t0 = time.monotonic()
+    extra: dict = {}
     try:
-        outcome, ok, notes, plan_json, faults = fn(seed, scale)
+        res = fn(seed, scale)
+        outcome, ok, notes, plan_json, faults = res[:5]
+        if len(res) > 5:  # optional per-drill metrics (resume_latency_s)
+            extra = res[5]
         err = ""
     except Exception as e:  # noqa: BLE001 — report, don't crash the runner
         outcome, ok, notes, plan_json, faults = "error", False, [], {}, {}
@@ -446,7 +583,7 @@ def run_drill(name: str, seed: int = DEFAULT_SEED,
     return DrillReport(
         name=name, seed=seed, expected=expected, outcome=outcome, ok=ok,
         duration_s=time.monotonic() - t0, plan=plan_json, faults=faults,
-        notes=notes, error=err,
+        notes=notes, error=err, **extra,
     )
 
 

@@ -107,6 +107,13 @@ def run_node(
         raise SystemExit(f"node {name!r} not in peer set {sorted(peers)}")
 
     share_store = EncryptedFileKV(Path(cfg.db_dir) / name, cfg.badger_password)
+    # crash-recovery WAL (default off): journals live sessions under the
+    # share store's AEAD so a SIGKILL'd node resumes mid-round after restart
+    session_wal = None
+    if cfg.session_wal:
+        from ..store.session_wal import SessionWALStore
+
+        session_wal = SessionWALStore(share_store)
     keyinfo = KeyinfoStore(control_kv)
     identity = IdentityStore(
         cfg.identity_dir,
@@ -125,6 +132,7 @@ def run_node(
         keyinfo=keyinfo,
         registry=registry,
         safe_prime_pool=cfg.safe_prime_pool or None,
+        session_wal=session_wal,
     )
     # multi-device hosts shard the session axis of batched dispatches
     # over every local chip (engine/sharded.py; no-op on one device)
@@ -148,6 +156,14 @@ def run_node(
     consumer.run()
     TimeoutConsumer(transport).run()
     registry.ready()
+    # boot-time crash recovery: replay incomplete WAL sessions AFTER the
+    # consumer subscribed (resumed peers' answers must not race our subs)
+    # and after ready() so peers treat us as live again
+    if session_wal is not None:
+        try:
+            consumer.resume_incomplete()
+        except Exception as e:  # noqa: BLE001 — recovery must never block boot
+            log.warn("WAL resume scan failed", node=name, error=repr(e))
     signing = SigningConsumer(transport)
     signing.run()
     log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")

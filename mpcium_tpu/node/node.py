@@ -26,6 +26,7 @@ from ..protocol.resharing import ResharingParty
 from ..registry.registry import PeerRegistry
 from ..store.keyinfo import KeyInfo, KeyinfoStore
 from ..store.kvstore import KVStore
+from ..store.session_wal import SessionWALStore, SessionWALWriter, WALReplay
 from ..transport.api import Transport
 from ..utils import log
 from .session import Session
@@ -57,6 +58,7 @@ class Node:
         safe_prime_pool: Optional[str] = None,
         min_paillier_bits: int = 2046,
         hello_timeout_s: Optional[float] = 20.0,
+        session_wal: Optional[SessionWALStore] = None,
     ):
         self.node_id = node_id
         self.peer_ids = sorted(set(peer_ids) | {node_id})
@@ -70,6 +72,9 @@ class Node:
         # chaos drills shrink it so partition failures surface inside the
         # drill budget instead of the default 20 s (session.py:63)
         self.hello_timeout_s = hello_timeout_s
+        # crash-recovery WAL namespace (None ⇒ feature off: sessions run
+        # exactly as before, no journal files are ever created)
+        self.session_wal = session_wal
         # ECDSA pre-params once at startup (reference node.go:69); the pool
         # file makes this seconds instead of minutes
         if preparams is None:
@@ -104,6 +109,21 @@ class Node:
         if raw is None:
             raise ProtocolError(f"no {key_type} share for wallet {wallet_id!r}")
         return KeygenShare.from_json(json.loads(raw))
+
+    # -- crash-recovery WAL -------------------------------------------------
+
+    def _wal_create(self, session_id: str, meta: dict) -> Optional[SessionWALWriter]:
+        """New journal for a fresh session (``meta`` holds everything
+        ``resume_session`` needs to rebuild the party after a crash).
+        WAL trouble never blocks live signing — it only disables recovery."""
+        if self.session_wal is None:
+            return None
+        try:
+            return self.session_wal.create(session_id, meta)
+        except Exception as e:  # noqa: BLE001
+            log.warn("session WAL create failed", session=session_id,
+                     error=repr(e))
+            return None
 
     # -- quorum selection ---------------------------------------------------
 
@@ -161,6 +181,13 @@ class Node:
             on_done=persist_and_done,
             on_error=on_error,
             hello_timeout_s=self.hello_timeout_s,
+            wal=self._wal_create(session_id, {
+                "kind": "keygen",
+                "key_type": key_type,
+                "wallet_id": wallet_id,
+                "threshold": threshold,
+                "participants": participants,
+            }),
         )
 
     # -- signing ------------------------------------------------------------
@@ -173,6 +200,7 @@ class Node:
         tx: bytes,
         on_done: Optional[Callable] = None,
         on_error: Optional[Callable] = None,
+        network_internal_code: str = "",
     ) -> Optional[Session]:
         """Returns None when this node is not in the selected quorum."""
         info = self.keyinfo.get(key_type, wallet_id)
@@ -231,6 +259,16 @@ class Node:
             on_done=on_done,
             on_error=on_error,
             hello_timeout_s=self.hello_timeout_s,
+            wal=self._wal_create(session_id, {
+                "kind": "sign",
+                "key_type": key_type,
+                "wallet_id": wallet_id,
+                "tx_id": tx_id,
+                "tx": tx.hex(),
+                "epoch_tag": epoch_tag,
+                "participants": quorum,
+                "network_internal_code": network_internal_code,
+            }),
         )
 
     # -- resharing ----------------------------------------------------------
@@ -284,6 +322,35 @@ class Node:
             old_epoch=info.epoch,
         )
 
+        return Session(
+            session_id=session_id,
+            party=party,
+            node_id=self.node_id,
+            participants=sorted(set(old_quorum) | set(new_committee)),
+            transport=self.transport,
+            identity=self.identity,
+            broadcast_topic=wire.resharing_broadcast_topic(key_type, wallet_id),
+            direct_topic_fn=lambda n: wire.resharing_direct_topic(key_type, n, wallet_id),
+            on_done=self._reshare_persist_cb(
+                party, key_type, wallet_id, info, on_done
+            ),
+            on_error=on_error,
+            hello_timeout_s=self.hello_timeout_s,
+            wal=self._wal_create(session_id, {
+                "kind": "reshare",
+                "key_type": key_type,
+                "wallet_id": wallet_id,
+                "new_threshold": new_threshold,
+                "old_quorum": old_quorum,
+                "new_committee": new_committee,
+                "old_epoch": info.epoch,
+            }),
+        )
+
+    def _reshare_persist_cb(self, party, key_type, wallet_id, info, on_done):
+        """Resharing completion: persist/supersede shares, then chain to the
+        caller's callback. Shared by the factory and the crash-resume path."""
+
         def persist_and_done(share):
             if share is not None:  # new-committee member
                 self.save_share(share, wallet_id)
@@ -309,16 +376,117 @@ class Node:
             if on_done:
                 on_done(share)
 
+        return persist_and_done
+
+    # -- crash resume -------------------------------------------------------
+
+    def resume_session(
+        self,
+        rep: WALReplay,
+        on_done: Optional[Callable] = None,
+        on_error: Optional[Callable] = None,
+    ) -> Session:
+        """Rebuild an in-flight session from its WAL replay: reconstruct
+        the party from the journaled factory arguments, restore the last
+        checkpoint, and hand the sent history + post-checkpoint envelopes
+        to the Session for wire replay. The participant set comes from the
+        journal, NOT from a fresh registry quorum — the peers of the
+        original run are the only valid counterparties."""
+        if self.session_wal is None:
+            raise ProtocolError("session WAL is not enabled")
+        meta = rep.meta
+        kind = meta.get("kind")
+        key_type = meta["key_type"]
+        wallet_id = meta["wallet_id"]
+        sid = rep.session_id
+        if kind == "keygen":
+            participants = list(meta["participants"])
+            if key_type == wire.KEY_TYPE_SECP256K1:
+                party = ECDSAKeygenParty(
+                    sid, self.node_id, participants, meta["threshold"],
+                    preparams=self.preparams,
+                    min_paillier_bits=self.min_paillier_bits,
+                )
+            else:
+                party = EDDSAKeygenParty(
+                    sid, self.node_id, participants, meta["threshold"]
+                )
+
+            def done_cb(share, _done=on_done):
+                self.save_share(share, wallet_id)
+                if _done:
+                    _done(share)
+
+            broadcast = wire.keygen_broadcast_topic(key_type, wallet_id)
+            direct = lambda n: wire.keygen_direct_topic(key_type, n, wallet_id)  # noqa: E731
+        elif kind == "sign":
+            quorum = list(meta["participants"])
+            share = self.load_share(key_type, wallet_id)
+            tx = bytes.fromhex(meta["tx"])
+            if key_type == wire.KEY_TYPE_SECP256K1:
+                party = ECDSASigningParty(
+                    sid, self.node_id, quorum, share,
+                    int.from_bytes(tx, "big"),
+                )
+            else:
+                party = EDDSASigningParty(sid, self.node_id, quorum, share, tx)
+            epoch_tag = meta["epoch_tag"]
+            done_cb = on_done
+            broadcast = wire.sign_broadcast_topic(key_type, wallet_id, epoch_tag)
+            direct = lambda n: wire.sign_direct_topic(key_type, n, epoch_tag)  # noqa: E731
+        elif kind == "reshare":
+            info = self.keyinfo.get(key_type, wallet_id)
+            if info is None:
+                raise ProtocolError(
+                    f"cannot resume reshare: no keyinfo for {wallet_id!r}"
+                )
+            old_quorum = list(meta["old_quorum"])
+            new_committee = list(meta["new_committee"])
+            is_old = self.node_id in set(old_quorum)
+            party = ResharingParty(
+                sid,
+                self.node_id,
+                key_type,
+                old_quorum,
+                new_committee,
+                meta["new_threshold"],
+                old_share=self.load_share(key_type, wallet_id) if is_old else None,
+                old_public_key=bytes.fromhex(info.public_key)
+                if info.public_key else None,
+                old_vss_commitments=[bytes.fromhex(c) for c in info.vss_commitments]
+                or None,
+                preparams=self.preparams
+                if key_type == wire.KEY_TYPE_SECP256K1 else None,
+                min_paillier_bits=self.min_paillier_bits,
+                old_epoch=meta["old_epoch"],
+            )
+            done_cb = self._reshare_persist_cb(
+                party, key_type, wallet_id, info, on_done
+            )
+            broadcast = wire.resharing_broadcast_topic(key_type, wallet_id)
+            direct = lambda n: wire.resharing_direct_topic(key_type, n, wallet_id)  # noqa: E731
+        else:
+            raise ProtocolError(f"unknown WAL session kind {kind!r}")
+        if rep.snapshot is not None:
+            party.restore(rep.snapshot)
+        # else: no checkpoint survived (crash/torn tail before the first
+        # one) — nothing was ever routed, so the party safely starts fresh
+        # inside the resume replay (resume_fresh below)
         return Session(
-            session_id=session_id,
+            session_id=sid,
             party=party,
             node_id=self.node_id,
-            participants=sorted(set(old_quorum) | set(new_committee)),
+            participants=sorted(party.party_ids),
             transport=self.transport,
             identity=self.identity,
-            broadcast_topic=wire.resharing_broadcast_topic(key_type, wallet_id),
-            direct_topic_fn=lambda n: wire.resharing_direct_topic(key_type, n, wallet_id),
-            on_done=persist_and_done,
+            broadcast_topic=broadcast,
+            direct_topic_fn=direct,
+            on_done=done_cb,
             on_error=on_error,
             hello_timeout_s=self.hello_timeout_s,
+            wal=self.session_wal.reopen(rep),
+            resumed=True,
+            resume_fresh=rep.snapshot is None,
+            resume_sent=rep.sent,
+            resume_envelopes=rep.envelopes,
         )

@@ -21,11 +21,33 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..identity.identity import IdentityStore
 from ..protocol.base import PartyBase, ProtocolError, RoundMsg
+from ..store.session_wal import SessionWALWriter
 from ..transport.api import Transport, TransportError
 from ..utils import log
 from ..wire import Envelope
 
 HELLO_ROUND = "__hello__"
+# broadcast by a crash-resumed participant: peers re-route their sent
+# history (broadcasts + unicasts addressed to the requester) so rounds the
+# dead process missed are redelivered — duplicates are protocol-legal
+# (identical-payload dedup in PartyBase._store)
+RESUME_ROUND = "__resume__"
+
+
+def _msg_to_json(m: RoundMsg) -> dict:
+    return {
+        "session_id": m.session_id,
+        "round": m.round,
+        "from_id": m.from_id,
+        "payload": m.payload,
+        "to": m.to,
+    }
+
+
+def _msg_from_json(d: dict) -> RoundMsg:
+    return RoundMsg(
+        d["session_id"], d["round"], d["from_id"], d["payload"], d.get("to")
+    )
 
 
 class SessionError(Exception):
@@ -62,6 +84,11 @@ class Session:
         on_error: Optional[Callable[[Exception], None]] = None,
         hello_timeout_s: Optional[float] = 20.0,
         send_patience_s: float = 0.0,
+        wal: Optional[SessionWALWriter] = None,
+        resumed: bool = False,
+        resume_fresh: bool = False,
+        resume_sent: Optional[Sequence[dict]] = None,
+        resume_envelopes: Optional[Sequence[bytes]] = None,
     ):
         self.session_id = session_id
         self.party = party
@@ -75,13 +102,33 @@ class Session:
         self.on_error = on_error
         self._lock = threading.RLock()
         self._subs: List = []
-        self._started = False
+        # a resumed session skips the hello barrier: its peers started long
+        # ago and will never re-hello; protocol traffic flows immediately
+        self._started = resumed
+        # one-shot claim that the quorum completed and start() is underway;
+        # _started flips only once start() has RUN (see _start_party)
+        self._start_claimed = resumed
         self._failed = False
         self._hellos = {node_id}
         self._buffer: List[RoundMsg] = []
+        # crash-recovery WAL (None ⇒ feature off: no journaling, no extra
+        # state, transcript byte-identical to a WAL-less build)
+        self._wal = wal
+        self._resumed = resumed
+        self._resume_fresh = resume_fresh
+        self._resume_sent = list(resume_sent or [])
+        self._resume_envelopes = list(resume_envelopes or [])
+        self._replaying = False
+        # full outbound history (routing metadata + signed wire bytes),
+        # kept so a peer's __resume__ request can be answered verbatim
+        self._sent_raw: List[tuple] = []
         self.created_at = time.monotonic()
         self.last_activity = self.created_at
         self._done_evt = threading.Event()
+        # one-shot claim for _finish, distinct from _done_evt: close() sets
+        # the event for waiters, which must not make a racing _finish skip
+        # its completion work (on_done + WAL drop)
+        self._finished = False
         self.hello_timeout_s = hello_timeout_s
         # extra unicast retry budget on TOP of the transport's own
         # (3 s × 3 attempts, reference point2point.go:26-45). Batched
@@ -119,6 +166,9 @@ class Session:
         )
         self._sender.start()
         self._send_hello()
+        if self._resumed:
+            self._replay_resume()
+            return
         # barrier deadline: a never-arriving quorum peer must fail the
         # session RETRYABLY within the signing window, not sit buffered
         # until the 30-minute GC (reference window: 30 s, sign_consumer.go:
@@ -132,9 +182,9 @@ class Session:
 
     def _hello_deadline(self) -> None:
         with self._lock:
-            if self._started or self._failed:
+            if self._start_claimed or self._failed:
                 return
-            # claim the failure INSIDE the same hold that checks _started:
+            # claim the failure INSIDE the same hold that checks the claim:
             # a final hello racing the deadline must not both start and
             # fail the session
             self._failed = True
@@ -159,6 +209,27 @@ class Session:
         # sentinel: the sender drains already-queued unicasts (peers may
         # still need them) and exits
         self._out_q.put(None)
+        # release the WAL file handle but KEEP the file: a close that isn't
+        # a completion (shutdown, GC reap) leaves the session resumable
+        if self._wal is not None:
+            self._wal.close()
+        # an external close of an unfinished session must not leave wait()
+        # callers blocking until their own timeout: signal them with a
+        # RETRYABLE failure (shutdown is not the protocol's fault, and the
+        # triggering event may legitimately be redelivered elsewhere)
+        with self._lock:
+            if self._done_evt.is_set():
+                return
+            if self._failed or self.party.done:
+                self._done_evt.set()
+                return
+            self._failed = True
+        self._done_evt.set()
+        if self.on_error:
+            try:
+                self.on_error(RetryableSessionError("session closed"))
+            except Exception as e:  # noqa: BLE001
+                log.error("on_error callback failed", error=repr(e))
 
     def wait(self, timeout_s: float) -> bool:
         return self._done_evt.wait(timeout_s)
@@ -218,11 +289,98 @@ class Session:
             )
             self.identity.sign_envelope(env)
             raw = env.encode()
+            with self._lock:
+                self._sent_raw.append((m.to, raw))
             if m.is_broadcast:
                 self.transport.pubsub.publish(self.broadcast_topic, raw)
             else:
                 # acked unicast, via the sender thread (see __init__ note)
                 self._out_q.put((m.to, raw))
+
+    # -- crash recovery -----------------------------------------------------
+
+    def _replay_resume(self) -> None:
+        """Rebuild the wire state of a crash-resumed session.
+
+        1. Re-route the full sent history from the WAL. Checkpoints are
+           written BEFORE their messages are routed, so any suffix of the
+           history may never have left the dead process; peers that did see
+           a message drop the duplicate.
+        2. Broadcast ``__resume__`` so peers re-route THEIR history — the
+           rounds they sent into the dead window are redelivered.
+        3. Re-deliver envelopes journaled after the last checkpoint (their
+           effect on party state was lost with the process).
+        """
+        try:
+            log.info("resuming session from WAL", session=self.session_id,
+                     node=self.node_id, sent=len(self._resume_sent),
+                     pending=len(self._resume_envelopes))
+            if self._resume_fresh:
+                # crash predated the first checkpoint: nothing was routed,
+                # so run start() now (it checkpoints before routing)
+                with self._lock:
+                    out = self.party.start()
+                    if self._wal is not None:
+                        self._checkpoint(out)
+                self._route(out)
+            self._route([_msg_from_json(d) for d in self._resume_sent])
+            env = Envelope(
+                session_id=self.session_id,
+                round=RESUME_ROUND,
+                from_id=self.node_id,
+                payload={},
+            )
+            self.identity.sign_envelope(env)
+            self.transport.pubsub.publish(self.broadcast_topic, env.encode())
+            pending, self._resume_envelopes = self._resume_envelopes, []
+            self._replaying = True
+            try:
+                for raw in pending:
+                    self._on_raw(raw)
+            finally:
+                self._replaying = False
+            # the checkpoint may already hold a finished party (crash landed
+            # between the final checkpoint and the result callback)
+            if self.party.done and not self._failed:
+                self._finish()
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _resend_history(self, requester: str) -> None:
+        """Answer a peer's ``__resume__``: re-publish every broadcast and
+        re-send the unicasts addressed to the requester, verbatim."""
+        with self._lock:
+            history = list(self._sent_raw)
+        if not history:
+            return
+        log.info("re-sending history for resumed peer",
+                 session=self.session_id, peer=requester, n=len(history))
+        for to, raw in history:
+            if to is None:
+                self.transport.pubsub.publish(self.broadcast_topic, raw)
+            elif to == requester:
+                self._out_q.put((to, raw))
+
+    def _checkpoint(self, out: Sequence[RoundMsg]) -> None:
+        """Journal party state + this step's outputs. Called under the
+        session lock, BEFORE the outputs are routed: a resumed party must
+        re-send the exact payloads peers may already hold, never re-derive
+        fresh randomness for them (peers would flag equivocation)."""
+        try:
+            self._wal.checkpoint(
+                self.party.snapshot(), [_msg_to_json(m) for m in out]
+            )
+        except Exception as e:  # noqa: BLE001
+            # a stale WAL is worse than none: resuming from it would
+            # re-derive randomness for payloads peers already hold
+            # (equivocation). Disable recovery for this session, keep going.
+            log.warn("session WAL checkpoint failed — disabling recovery",
+                     session=self.session_id, error=repr(e))
+            try:
+                self._wal.drop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._wal = None
 
     def _send_loop(self) -> None:
         while True:
@@ -271,7 +429,7 @@ class Session:
         if env.round == HELLO_ROUND:
             if env.payload.get("bye"):
                 with self._lock:
-                    if self._started or self._failed:
+                    if self._start_claimed or self._failed:
                         return
                     self._failed = True
                 if self._hello_timer is not None:
@@ -286,6 +444,21 @@ class Session:
                 return
             self._on_hello(env.from_id)
             return
+        if env.round == RESUME_ROUND:
+            # a peer came back from the dead: count it present and replay
+            # our history so the rounds it missed reach it again
+            self._on_hello(env.from_id)
+            self._resend_history(env.from_id)
+            return
+        # journal the verified envelope BEFORE delivery: if we die inside
+        # receive(), replay re-delivers it (re-deliveries during resume are
+        # already on disk — don't journal them twice)
+        if self._wal is not None and not self._replaying:
+            try:
+                self._wal.envelope(raw)
+            except Exception as e:  # noqa: BLE001
+                log.warn("session WAL append failed", session=self.session_id,
+                         error=repr(e))
         msg = RoundMsg(
             session_id=env.session_id,
             round=env.round,
@@ -308,11 +481,11 @@ class Session:
                 # answer late joiners so they converge too
                 self._send_hello()
             if (
-                not self._started
+                not self._start_claimed
                 and not self._failed
                 and self._hellos >= set(self.participants)
             ):
-                self._started = True
+                self._start_claimed = True
                 start_now = True
         if start_now:
             if self._hello_timer is not None:
@@ -321,9 +494,22 @@ class Session:
 
     def _start_party(self) -> None:
         try:
+            # start() can burn SECONDS of CPU (ECDSA keygen: DLN proofs over
+            # big moduli) — run it OUTSIDE the lock so inbound deliveries
+            # buffer-and-ack instantly instead of pinning a transport worker
+            # until the sender's ack budget runs out. Only this thread
+            # touches the party until _started flips: every inbound message
+            # buffers while _started is False, so receive() cannot run
+            # before start() has, and start() runs exactly once
+            # (_start_claimed is a one-shot)
+            out = self.party.start()
             with self._lock:
-                out = self.party.start()
+                self._started = True
                 buffered, self._buffer = self._buffer, []
+                if self._wal is not None:
+                    # commit the start-time randomness (nonce commitments,
+                    # Shamir coefficients) before anything leaves the node
+                    self._checkpoint(out)
             self._route(out)
             for m in buffered:
                 self._deliver(m)
@@ -337,6 +523,8 @@ class Session:
                     return
                 out = self.party.receive(msg)
                 finished = self.party.done
+                if self._wal is not None and (out or finished):
+                    self._checkpoint(out)
             self._route(out)
             if finished:
                 self._finish()
@@ -346,9 +534,10 @@ class Session:
             self._fail(e)
 
     def _finish(self) -> None:
-        if self._done_evt.is_set():
-            return
-        self._done_evt.set()
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
         log.info("session complete", session=self.session_id, node=self.node_id)
         if self.on_done:
             try:
@@ -356,6 +545,21 @@ class Session:
             except Exception as e:  # noqa: BLE001
                 log.error("on_done callback failed", session=self.session_id,
                           error=repr(e))
+                self._done_evt.set()
+                return  # keep the WAL: completion isn't durable yet
+        # drop the WAL only after on_done persisted its result — a crash
+        # before this line resumes into a done party and re-runs on_done
+        # (idempotent: share puts and result enqueues are keyed). A racing
+        # close() may have released the writer handle already: appends
+        # no-op on a closed writer and drop() unlinks by path, so the file
+        # still goes away.
+        if self._wal is not None:
+            try:
+                self._wal.done()
+                self._wal.drop()
+            except Exception:  # noqa: BLE001
+                pass
+        self._done_evt.set()
 
     def _fail(self, e: Exception, _claimed: bool = False) -> None:
         if not _claimed:
@@ -366,6 +570,13 @@ class Session:
         culprit = getattr(e, "culprit", None)
         log.error("session failed", session=self.session_id, node=self.node_id,
                   error=str(e), culprit=culprit or "")
+        # a failed session must not resurrect at the next boot; only a hard
+        # crash (which never reaches _fail) leaves the WAL behind
+        if self._wal is not None:
+            try:
+                self._wal.drop()
+            except Exception:  # noqa: BLE001
+                pass
         self._done_evt.set()
         if self.on_error:
             try:

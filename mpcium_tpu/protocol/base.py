@@ -47,6 +47,119 @@ class RoundMsg:
         return self.to is None
 
 
+# ---------------------------------------------------------------------------
+# snapshot codec (crash-recoverable sessions)
+#
+# Party state is a mix of JSON-safe payload dicts (the inbox) and protocol
+# secrets: python ints, bytes, curve points, Paillier/MtA objects. The WAL
+# (store/session_wal.py) needs all of it round-trippable through JSON, so
+# values are encoded with explicit tags. Every *plain* dict is encoded as a
+# ``{"__d": [[k, v], ...]}`` pair list, which makes the tag space
+# collision-free (a real payload dict can never be mistaken for a tag) and
+# preserves non-string keys (Shamir share maps are keyed by int x-coords).
+# ---------------------------------------------------------------------------
+
+_SNAP_TYPES: Dict[str, tuple] = {}  # name -> (cls, encode_fn, decode_fn)
+
+
+def register_snap_type(name: str, cls, enc, dec) -> None:
+    """Register a custom type for party snapshots. ``enc`` maps an instance
+    to a JSON-safe value, ``dec`` inverts it."""
+    _SNAP_TYPES[name] = (cls, enc, dec)
+
+
+def _ensure_snap_types() -> None:
+    """Lazy registration of the crypto object types every protocol party
+    stores (deferred so importing protocol.base stays cheap and cycle-free)."""
+    if "edpoint" in _SNAP_TYPES:
+        return
+    from ..core import hostmath as hm
+    from ..core.paillier import PaillierPublicKey
+
+    register_snap_type(
+        "edpoint", hm.EdPoint,
+        lambda p: hm.ed_compress(p).hex(),
+        lambda v: hm.ed_decompress(bytes.fromhex(v)),
+    )
+    register_snap_type(
+        "secppoint", hm.SecpPoint,
+        lambda p: "" if p.is_infinity else hm.secp_compress(p).hex(),
+        lambda v: hm.SECP_INF if v == "" else hm.secp_decompress(bytes.fromhex(v)),
+    )
+    register_snap_type(
+        "paillier_pk", PaillierPublicKey,
+        lambda pk: str(pk.N),
+        lambda v: PaillierPublicKey(int(v)),
+    )
+    # a node's PreParams are drawn from the safe-prime pool at boot, so a
+    # restarted process holds DIFFERENT ones — mid-keygen parties must
+    # resume with the exact material their round-1 broadcast committed to
+    from ..core.paillier import PreParams
+
+    register_snap_type(
+        "preparams", PreParams,
+        lambda p: p.to_json(), lambda v: PreParams.from_json(v),
+    )
+    register_snap_type(
+        "keygen_share", KeygenShare,
+        lambda s: s.to_json(), lambda v: KeygenShare.from_json(v),
+    )
+    from .ecdsa.mta import MtaInit, MtaResp
+
+    register_snap_type(
+        "mta_init", MtaInit,
+        lambda m: m.to_json(), lambda v: MtaInit.from_json(v),
+    )
+    register_snap_type(
+        "mta_resp", MtaResp,
+        lambda m: m.to_json(), lambda v: MtaResp.from_json(v),
+    )
+
+
+def snap_encode(v: Any) -> Any:
+    """Party state → JSON-safe tagged value (see module comment above)."""
+    if v is None or isinstance(v, (bool, str, float)):
+        return v
+    if isinstance(v, int):
+        return {"__i": str(v)}
+    if isinstance(v, (bytes, bytearray)):
+        return {"__b": bytes(v).hex()}
+    if isinstance(v, list):
+        return [snap_encode(x) for x in v]
+    if isinstance(v, tuple):
+        return {"__t": [snap_encode(x) for x in v]}
+    if isinstance(v, dict):
+        return {"__d": [[snap_encode(k), snap_encode(x)] for k, x in v.items()]}
+    _ensure_snap_types()
+    for name, (cls, enc, _dec) in _SNAP_TYPES.items():
+        if isinstance(v, cls):
+            return {"__o": [name, enc(v)]}
+    raise TypeError(f"snapshot cannot encode {type(v).__name__}")
+
+
+def snap_decode(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, str, float)):
+        return v
+    if isinstance(v, list):
+        return [snap_decode(x) for x in v]
+    if isinstance(v, dict):
+        if "__i" in v:
+            return int(v["__i"])
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__t" in v:
+            return tuple(snap_decode(x) for x in v["__t"])
+        if "__d" in v:
+            return {snap_decode(k): snap_decode(x) for k, x in v["__d"]}
+        if "__o" in v:
+            name, payload = v["__o"]
+            _ensure_snap_types()
+            if name not in _SNAP_TYPES:
+                raise TypeError(f"snapshot references unknown type {name!r}")
+            return _SNAP_TYPES[name][2](payload)
+    raise TypeError(f"snapshot cannot decode {v!r}")
+
+
 def party_xs(party_ids: Sequence[str]) -> Dict[str, int]:
     """Deterministic Shamir x-coordinates: 1-based rank in the sorted ID
     list. Every party derives the same mapping from the same participant set
@@ -112,6 +225,54 @@ class PartyBase:
 
     def _round_payloads(self, round_name: str) -> Dict[str, Dict[str, Any]]:
         return self._inbox.get(round_name, {})
+
+    # -- crash-recovery snapshots -------------------------------------------
+    #
+    # ``snapshot()`` captures the party's complete message-driven state: the
+    # per-round inbox plus every attribute named in ``_SNAP_EXTRA`` (the
+    # per-protocol secrets — nonces, Shamir coefficients, commitments —
+    # whose loss would change the transcript on resume). ``restore()``
+    # inverts it onto a freshly constructed party with the same
+    # constructor arguments. Attributes that do not exist yet (rounds not
+    # reached) are simply absent from the snapshot and stay absent.
+
+    _SNAP_EXTRA: Sequence[str] = ()
+
+    def snapshot(self) -> Dict[str, Any]:
+        extra = {}
+        for name in self._SNAP_EXTRA:
+            if hasattr(self, name):
+                extra[name] = snap_encode(getattr(self, name))
+        return {
+            "v": 1,
+            "protocol": type(self).__name__,
+            "session_id": self.session_id,
+            "done": self.done,
+            "result": snap_encode(self.result),
+            "inbox": snap_encode(self._inbox),
+            "extra": extra,
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        if snap.get("protocol") != type(self).__name__:
+            raise ProtocolError(
+                f"snapshot for {snap.get('protocol')!r} restored into "
+                f"{type(self).__name__}"
+            )
+        if snap.get("session_id") != self.session_id:
+            raise ProtocolError(
+                f"snapshot for session {snap.get('session_id')!r} restored "
+                f"into {self.session_id!r}"
+            )
+        self._inbox = snap_decode(snap["inbox"])
+        for name, v in snap.get("extra", {}).items():
+            setattr(self, name, snap_decode(v))
+        self.done = bool(snap.get("done", False))
+        self.result = snap_decode(snap.get("result"))
+        self._post_restore()
+
+    def _post_restore(self) -> None:
+        """Recompute derived (non-serialized) state; per-protocol hook."""
 
     # -- helpers ------------------------------------------------------------
 

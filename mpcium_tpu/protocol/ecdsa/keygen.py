@@ -37,6 +37,13 @@ class ECDSAKeygenParty(PartyBase):
     """One party of the GG18 DKG. ``preparams`` is this node's startup
     artifact; ``min_paillier_bits`` is lowered only in tests (small keys)."""
 
+    # "pre" rides along because a restarted node draws FRESH preparams from
+    # the pool — but round 1 already committed the old ones to the peers
+    _SNAP_EXTRA = (
+        "_sent_r2", "_sent_r3", "_coeffs", "_shares_out", "_points",
+        "_commitment", "_blind", "_peer_pk", "_peer_rp", "pre",
+    )
+
     def __init__(
         self,
         session_id: str,

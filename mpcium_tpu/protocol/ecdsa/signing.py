@@ -50,6 +50,16 @@ R9 = "ecdsa/sign/9"
 class ECDSASigningParty(PartyBase):
     """One signer among the quorum (≥ t+1 keygen participants)."""
 
+    # k_i/γ_i and every phase-5 secret are committed to peers; a resumed
+    # signer must replay the identical values (crash-recovery WAL)
+    _SNAP_EXTRA = (
+        "_stage", "k_i", "gamma_i", "Gamma_i", "_gamma_commit",
+        "_gamma_blind", "_mta_inits", "_beta", "_nu", "_delta_i",
+        "_sigma_i", "_R", "_r", "_s_i", "_l_i", "_rho_i", "_V_i", "_A_i",
+        "_va_commit", "_va_blind", "_peer_VA", "_U_i", "_T_i",
+        "_ut_commit", "_ut_blind",
+    )
+
     def __init__(
         self,
         session_id: str,

@@ -27,6 +27,12 @@ R2_SHARE = "eddsa/kg/2/share"
 
 
 class EDDSAKeygenParty(PartyBase):
+    # everything rng-derived before/at the last send (crash-recovery WAL)
+    _SNAP_EXTRA = (
+        "_sent_r2", "_coeffs", "_shares_out", "_points", "_commitment",
+        "_blind",
+    )
+
     def __init__(self, session_id, self_id, party_ids, threshold: int, rng=None):
         import secrets as _secrets
 

@@ -35,6 +35,13 @@ class EDDSASigningParty(PartyBase):
     """One signer among the chosen quorum (|party_ids| ≥ t+1 participants,
     all of whom hold keygen shares for this wallet)."""
 
+    # nonce + commitments: a resumed signer MUST reuse the exact r_i it
+    # committed to, or peers see a decommitment mismatch (crash-recovery WAL)
+    _SNAP_EXTRA = (
+        "_sent_r2", "_sent_r3", "_r", "_R_i", "_R_i_bytes", "_commitment",
+        "_blind", "_R_bytes", "_s_i", "_c",
+    )
+
     def __init__(
         self,
         session_id: str,

@@ -99,6 +99,12 @@ class ResharingParty(PartyBase):
     self is in the new committee.
     """
 
+    _SNAP_EXTRA = (
+        "_sent_r2", "_sent_r3", "_w_i", "_coeffs", "_shares_out",
+        "_points", "_commitment", "_blind", "_x_new", "_new_agg",
+        "new_agg", "pre",
+    )
+
     def __init__(
         self,
         session_id: str,

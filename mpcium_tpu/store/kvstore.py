@@ -81,7 +81,19 @@ class EncryptedFileKV(KVStore):
                 ) from e
 
     def _fname(self, key: str) -> Path:
-        return self.root / hashlib.sha256(self._key + key.encode()).hexdigest()[:48]
+        return self.root / self.hashed_name(key)
+
+    # public sealing surface: the session WAL (store/session_wal.py) seals
+    # its entries with this store's AEAD + key-derived filenames so WAL
+    # files leak exactly as little as the share files next to them
+    def hashed_name(self, key: str) -> str:
+        return hashlib.sha256(self._key + key.encode()).hexdigest()[:48]
+
+    def seal(self, data: bytes, ad: bytes) -> bytes:
+        return self._seal(data, ad)
+
+    def unseal(self, blob: bytes, ad: bytes) -> bytes:
+        return self._open(blob, ad)
 
     def _seal(self, data: bytes, ad: bytes) -> bytes:
         nonce = secrets.token_bytes(12)

@@ -985,8 +985,19 @@ class TcpClient:
         return sock, cipher
 
     def _establish_any(self, deadline: float, initial: bool = False):
-        """Walk the address list (with backoff) until a broker accepts."""
-        backoff = 0.1
+        """Walk the address list (with backoff) until a broker accepts.
+
+        The sleep uses AWS-style decorrelated jitter (sleep ~ U(base,
+        3·prev), capped): when a broker dies, EVERY client of the bus
+        enters this loop at the same instant, and a deterministic
+        doubling schedule would hammer the reborn broker in synchronized
+        waves — each wave a burst of simultaneous accepts, handshakes
+        and auth round-trips. Randomizing per-client spreads the herd.
+        """
+        import random
+
+        base, cap = 0.1, 2.0
+        backoff = base
         last: Exception = TransportError("no broker address configured")
         while True:
             for addr in self._addrs:
@@ -1003,7 +1014,7 @@ class TcpClient:
                     f"no broker reachable among {self._addrs}: {last!r}"
                 )
             time.sleep(backoff)
-            backoff = min(backoff * 2, 2.0)
+            backoff = min(cap, random.uniform(base, backoff * 3))
 
     def close(self) -> None:
         self._closed = True

@@ -2,8 +2,9 @@
 """Chaos drill CLI (ISSUE 3): run named fault-injection drills against an
 in-process cluster and print their structured reports as JSON.
 
-    python scripts/chaos_drill.py                       # all 4 drills
+    python scripts/chaos_drill.py                       # the whole catalog
     python scripts/chaos_drill.py --plan partition      # one drill
+    python scripts/chaos_drill.py --plan kill-resume    # SIGKILL + WAL resume
     python scripts/chaos_drill.py --seed 42 --plan drop-jitter
     python scripts/chaos_drill.py --list
 

@@ -84,3 +84,42 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def no_leaked_nondaemon_threads():
+    """Fail the session if tests leak non-daemon threads.
+
+    A leaked non-daemon thread hangs the interpreter at exit — in CI that
+    reads as a pytest timeout with no traceback, the single worst failure
+    mode to debug. Every component here (sessions, consumers, brokers,
+    clusters) owns threads; this fixture makes "forgot to close it" loud.
+    Daemon threads are exempt: they are explicitly declared kill-at-exit
+    (sender loops, GC loops, loopback pools are all daemonized)."""
+    import threading
+    import time
+
+    # process-lifetime singletons are not leaks: the OT pipeline's host
+    # worker pool (mta_ot._host_pool) is created lazily once per process
+    # and lives until interpreter exit by design
+    _SINGLETONS = ("ot-host",)
+
+    baseline = set(threading.enumerate())
+    yield
+    # grace poll: threads mid-join at the last test's teardown get a
+    # moment to finish before we call them leaked
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t not in baseline and t.is_alive() and not t.daemon
+            and not t.name.startswith(_SINGLETONS)
+        ]
+        if not leaked:
+            return
+        time.sleep(0.1)
+    names = sorted(t.name for t in leaked)
+    pytest.fail(
+        f"tests leaked non-daemon thread(s): {names} — close the "
+        f"session/consumer/broker that started them", pytrace=False
+    )

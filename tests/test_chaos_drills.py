@@ -53,6 +53,19 @@ def test_drill_node_crash_recovers():
     assert report.faults["counters"]["crash_node#0"]["crash"] == 1
 
 
+def test_drill_kill_resume():
+    """node2 SIGKILLs on its round-2 signing broadcast and is respawned:
+    the WAL session resumes mid-round and the SAME session completes with
+    a bit-identical signature on all three nodes (no restart-from-scratch,
+    no fresh nonce)."""
+    report = run_drill("kill-resume", seed=7)
+    _assert_ok(report)
+    assert report.faults["counters"]["crash_node#0"]["crash"] == 1
+    # the report carries how long resume took from respawn to signature
+    assert report.resume_latency_s > 0
+    assert any("bit-identical" in n for n in report.notes)
+
+
 def test_drill_report_reproducible_from_seed():
     """Same (drill, seed) ⇒ same outcome and the identical serialized
     plan — the reproduction contract scripts/chaos_drill.py documents."""

@@ -1,0 +1,165 @@
+"""Consumer-side failure surfacing: the dead-letter → timeout-event bridge
+(TimeoutConsumer) and the EventConsumer GC that reaps stale sessions and
+aged session-less claims."""
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+from mpcium_tpu import wire
+from mpcium_tpu.consumers.event_consumer import EventConsumer
+from mpcium_tpu.consumers.signing_consumer import TimeoutConsumer
+from mpcium_tpu.transport.loopback import LoopbackFabric
+
+
+def _result_box(transport, tx_id):
+    """Subscribe the per-tx result queue; returns (events, arrived, sub)."""
+    events, arrived = [], threading.Event()
+
+    def h(data):
+        events.append(wire.SigningResultEvent.from_json(json.loads(data)))
+        arrived.set()
+
+    sub = transport.queues.dequeue(f"{wire.TOPIC_SIGNING_RESULT}.{tx_id}", h)
+    return events, arrived, sub
+
+
+def _sign_msg(tx_id):
+    return wire.SignTxMessage(
+        key_type=wire.KEY_TYPE_ED25519,
+        wallet_id="w-gc",
+        network_internal_code="testnet",
+        tx_id=tx_id,
+        tx=b"\x01\x02",
+    )
+
+
+# ---------------------------------------------------------------------------
+# TimeoutConsumer dead-letter bridge
+# ---------------------------------------------------------------------------
+
+
+def test_dead_letter_emits_timeout_error_event():
+    fabric = LoopbackFabric()
+    transport = fabric.transport()
+    tc = TimeoutConsumer(transport)
+    tc.run()
+    msg = _sign_msg("tx-dl")
+    events, arrived, sub = _result_box(transport, "tx-dl")
+    tc._on_dead_letter(
+        wire.TOPIC_SIGNING_REQUEST, wire.canonical_json(msg.to_json()), 5
+    )
+    assert arrived.wait(5.0), "no result event emitted"
+    ev = events[0]
+    assert ev.result_type == wire.RESULT_ERROR
+    assert ev.is_timeout
+    assert ev.wallet_id == "w-gc" and ev.tx_id == "tx-dl"
+    assert ev.network_internal_code == "testnet"
+    assert "5 deliveries" in ev.error_reason
+    sub.unsubscribe()
+    fabric.close()
+
+
+def test_dead_letter_ignores_foreign_topics():
+    fabric = LoopbackFabric()
+    transport = fabric.transport()
+    tc = TimeoutConsumer(transport)
+    msg = _sign_msg("tx-foreign")
+    events, arrived, sub = _result_box(transport, "tx-foreign")
+    tc._on_dead_letter(
+        "mpc.other.queue", wire.canonical_json(msg.to_json()), 5
+    )
+    assert not arrived.wait(0.3), "event emitted for a non-signing topic"
+    assert events == []
+    sub.unsubscribe()
+    fabric.close()
+
+
+def test_dead_letter_tolerates_undecodable_payload():
+    fabric = LoopbackFabric()
+    tc = TimeoutConsumer(fabric.transport())
+    # must log-and-return, not raise back into the transport
+    tc._on_dead_letter(wire.TOPIC_SIGNING_REQUEST, b"\x00 not json", 3)
+    fabric.close()
+
+
+# ---------------------------------------------------------------------------
+# EventConsumer GC
+# ---------------------------------------------------------------------------
+
+
+def _mk_ec(transport, **kw):
+    # the GC path touches only node_id (logging); no real Node needed
+    node = SimpleNamespace(node_id="n0", session_wal=None)
+    return EventConsumer(node, transport, **kw)
+
+
+class _FakeSession:
+    """Looks stale to the GC; close() re-enters the consumer bookkeeping
+    the way a real session's on_error does."""
+
+    def __init__(self, ec, key):
+        self.last_activity = time.monotonic() - 10_000.0
+        self.closed = threading.Event()
+        self._ec, self._key = ec, key
+
+    def close(self):
+        self.closed.set()
+        self._ec._release(self._key)  # must not deadlock: reap closes outside the lock
+
+
+def test_gc_reaps_stale_signing_claim_and_emits_timeout():
+    fabric = LoopbackFabric()
+    transport = fabric.transport()
+    ec = _mk_ec(transport, session_timeout_s=0.2, gc_interval_s=0.05)
+    msg = _sign_msg("tx-reap")
+    key = f"{msg.wallet_id}-{msg.tx_id}"
+    assert ec._claim(key, meta=("sign", msg))
+    fs = _FakeSession(ec, key)
+    ec._track(key, [fs])
+    events, arrived, sub = _result_box(transport, "tx-reap")
+    t = threading.Thread(target=ec._gc_loop, daemon=True)
+    t.start()
+    try:
+        assert fs.closed.wait(5.0), "stale session was not closed"
+        assert arrived.wait(5.0), "reap emitted no client-facing event"
+        ev = events[0]
+        assert ev.result_type == wire.RESULT_ERROR and ev.is_timeout
+        assert ev.tx_id == "tx-reap"
+        assert "reaped" in ev.error_reason
+        with ec._lock:
+            assert key not in ec._sessions
+            assert key not in ec._claim_meta
+    finally:
+        ec._gc_stop.set()
+        t.join(2.0)
+        sub.unsubscribe()
+        fabric.close()
+
+
+def test_gc_reaps_aged_empty_claim_but_spares_fresh_ones():
+    # a session-less claim (the _claim→_track window, or an orphan) must be
+    # reaped once aged — an unreaped empty claim answers WIP to every
+    # redelivery forever — while a fresh claim survives the same sweep
+    fabric = LoopbackFabric()
+    ec = _mk_ec(fabric.transport(), session_timeout_s=0.5, gc_interval_s=0.05)
+    assert ec._claim("keygen-old")
+    with ec._lock:
+        ec._claim_ts["keygen-old"] -= 10.0  # age it artificially
+    assert ec._claim("keygen-fresh")
+    t = threading.Thread(target=ec._gc_loop, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with ec._lock:
+                if "keygen-old" not in ec._sessions:
+                    break
+            time.sleep(0.02)
+        with ec._lock:
+            assert "keygen-old" not in ec._sessions, "aged claim not reaped"
+            assert "keygen-fresh" in ec._sessions, "fresh claim reaped"
+    finally:
+        ec._gc_stop.set()
+        t.join(2.0)
+        fabric.close()
