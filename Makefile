@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install test test-all bench broker chaos setup-identities setup-initiator clean
+.PHONY: install test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -36,6 +36,15 @@ chaos:
 
 chaos-tests:
 	$(PY) -m pytest tests/ -m chaos -q
+
+# SLO load soak (ISSUE 6): bursty mixed traffic + batch-chaos fault plan,
+# accounting invariant enforced (non-zero exit on any silent drop);
+# committed reports (SOAK_*.json) come from this entry point
+soak:
+	$(PY) scripts/load_soak.py --out SOAK_local.json
+
+soak-tests:
+	$(PY) -m pytest tests/ -m soak -q
 
 # dev stack: durable broker on :4333 (the docker-compose/nats analogue)
 broker:

@@ -49,9 +49,12 @@ class MPCClient:
         )
         log.info("signing requested", wallet=msg.wallet_id, tx=msg.tx_id)
 
-    def resharing(self, wallet_id: str, new_threshold: int, key_type: str) -> None:
+    def resharing(self, wallet_id: str, new_threshold: int, key_type: str,
+                  deadline_ms: int = 0,
+                  priority: str = wire.PRIORITY_BULK) -> None:
         msg = wire.ResharingMessage(
-            wallet_id=wallet_id, new_threshold=new_threshold, key_type=key_type
+            wallet_id=wallet_id, new_threshold=new_threshold,
+            key_type=key_type, deadline_ms=deadline_ms, priority=priority,
         )
         msg.signature = self.initiator.sign(msg.raw())
         self.transport.pubsub.publish(

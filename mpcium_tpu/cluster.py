@@ -133,13 +133,22 @@ class LocalCluster(SyncOps):
         session_timeout_s: Optional[float] = None,  # EventConsumer GC knobs
         gc_interval_s: Optional[float] = None,  # (chaos drills shrink both)
         session_wal: bool = False,  # encrypted per-round WAL + crash resume
+        batch_max_batch: Optional[int] = None,  # SLO batching knobs (None =
+        batch_deadline_ms: Optional[int] = None,  # config defaults; see
+        batch_max_queue_depth: Optional[int] = None,  # config.py batch_*)
+        batch_manifest_timeout_s: Optional[float] = None,
     ):
         from .config import init_config
 
         self.root = Path(root_dir or tempfile.mkdtemp(prefix="mpcium-tpu-"))
         self.node_ids = [f"node{i}" for i in range(n_nodes)]
+        # None overrides are skipped by init_config → config defaults apply
         init_config(path=str(self.root / "nonexistent.yaml"),
-                    mpc_threshold=threshold)
+                    mpc_threshold=threshold,
+                    batch_max_batch=batch_max_batch,
+                    batch_deadline_ms=batch_deadline_ms,
+                    batch_max_queue_depth=batch_max_queue_depth,
+                    batch_manifest_timeout_s=batch_manifest_timeout_s)
         self.broker = None
         self.standby_broker = None
         if transport == "tcp":
@@ -271,6 +280,20 @@ class LocalCluster(SyncOps):
         # boot-time crash recovery, after ready() — mirrors daemon.run_node
         ec.resume_incomplete()
         return ec
+
+    def health(self) -> Dict[str, dict]:
+        """Per-node operational snapshots (EventConsumer.health): live
+        sessions, dedup claims, and every scheduler metric — lane queue
+        depths, shed counters, fill ratios, latency percentiles."""
+        return {nid: ec.health() for nid, ec in self.node_consumers.items()}
+
+    def metrics_snapshot(self) -> Dict[str, dict]:
+        """Just the metric registries, keyed by node id (the soak harness
+        and smoke tests consume this)."""
+        return {
+            nid: ec.metrics.snapshot()
+            for nid, ec in self.node_consumers.items()
+        }
 
     def _wrap_faults(self, owner: str, transport):
         """Wrap ``transport`` in a FaultyTransport when a fault plan is

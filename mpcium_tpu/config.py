@@ -39,6 +39,13 @@ class AppConfig:
     broker_standbys: str = ""  # failover endpoints, "host:port[,host:port]"
     batch_signing: bool = False  # TPU batch scheduler for ed25519 signing
     batch_window_s: float = 0.05
+    # SLO-aware continuous batching (consumers/batch_scheduler.py)
+    batch_max_batch: int = 1024  # dispatch at this many entries OR window age
+    batch_manifest_timeout_s: float = 2.0  # deputy takeover at T, fallback 2T
+    batch_patience_s: float = 900.0  # decline-responder / covered-entry TTL
+    batch_deadline_ms: int = 30000  # default per-request deadline budget
+    batch_max_queue_depth: int = 100000  # intake bound; over-depth submits shed
+    batch_decline_cap: int = 64  # concurrent decline responders (oldest evicted)
     chaos_fault_plan: str = ""  # path to a faults.FaultPlan JSON ("" = off)
     session_wal: bool = False  # encrypted per-round session WAL + crash resume
     peers_file: str = "peers.json"

@@ -31,13 +31,33 @@ rounds on the engine kernels). ECDSA buckets additionally key on the
 quorum's Paillier/ring-Pedersen material digest so one batch maps to one
 modulus-context set; wallets with no GG18 aux material (never produced by
 this framework's keygen) fall back to the per-session path.
+
+SLO-aware continuous batching: every entry carries a DEADLINE (from the
+request's ``deadline_ms`` or the config default) and a LANE (interactive
+or bulk, from the request's ``priority``). Dispatch is continuous — a
+bucket fires whenever ``max_batch`` entries are buffered OR the oldest
+entry reaches ``window_s`` — and batches fill interactive-lane-first,
+oldest-deadline-first. All timing (windows, liveness fallbacks, decline
+expiries, deadline sweeps) runs on ONE timing-wheel thread, so a million
+buffered wallets costs one thread, not thousands of ``threading.Timer``s.
+Intake is BOUNDED: past ``max_queue_depth`` buffered entries, a submit is
+refused honestly — a *retryable* error event is published, the reply inbox
+gets ERR, the dedup claim is released, and a shed counter ticks; nothing
+is ever dropped silently. A buffered entry whose deadline expires before
+a manifest covers it is shed the same way (the deputy never re-fires an
+already-expired entry). Everything is observable through a
+``utils.metrics.MetricsRegistry``: per-lane queue depth, batch fill
+ratio, dispatch age, shed/takeover/fallback counts, end-to-end latency.
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import json
 import secrets
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -48,6 +68,98 @@ from ..protocol.base import KeygenShare, ProtocolError
 from ..protocol.eddsa.batch_signing import BatchedEDDSASigningParty
 from ..transport.api import Transport
 from ..utils import log
+from ..utils.metrics import MetricsRegistry
+
+_DIGEST_CACHE_CAP = 4096  # (key_type, wallet, epoch) -> material digest LRU
+_INTAKE_TS_CAP = 1 << 18  # e2e-latency bookkeeping bound (entries, not bytes)
+
+
+class _TimingWheel:
+    """One daemon thread serving every scheduler timer.
+
+    ``schedule(key, delay, fn)`` arms (or re-arms, replacing) a named
+    timer; ``cancel(key)`` disarms it. Internally a heap of
+    (fire_at, seq, key) with a per-key generation dict so replaced or
+    cancelled entries are skipped lazily — no heap surgery on the hot
+    path. Callbacks run on the wheel thread and must not block: every
+    scheduler callback either grabs the scheduler lock briefly or hands
+    real work to a batch thread.
+    """
+
+    def __init__(self, name: str = "timing-wheel") -> None:
+        self._cond = threading.Condition()
+        self._heap: List[Tuple[float, int, object]] = []
+        self._armed: Dict[object, Tuple[int, Callable[[], None]]] = {}
+        self._seq = itertools.count()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def schedule(self, key, delay_s: float, fn: Callable[[], None]) -> None:
+        fire_at = time.monotonic() + max(0.0, delay_s)
+        with self._cond:
+            if self._closed:
+                return
+            seq = next(self._seq)
+            self._armed[key] = (seq, fn)
+            heapq.heappush(self._heap, (fire_at, seq, key))
+            self._cond.notify()
+
+    def schedule_if_absent(
+        self, key, delay_s: float, fn: Callable[[], None]
+    ) -> bool:
+        with self._cond:
+            if self._closed or key in self._armed:
+                return False
+        self.schedule(key, delay_s, fn)
+        return True
+
+    def cancel(self, key) -> None:
+        with self._cond:
+            self._armed.pop(key, None)
+
+    def contains(self, key) -> bool:
+        with self._cond:
+            return key in self._armed
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._armed.clear()
+            self._heap.clear()
+            self._cond.notify()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                fn = None
+                if self._heap:
+                    fire_at, seq, key = self._heap[0]
+                    armed = self._armed.get(key)
+                    if armed is None or armed[0] != seq:
+                        heapq.heappop(self._heap)  # replaced/cancelled
+                        continue
+                    if fire_at <= now:
+                        heapq.heappop(self._heap)
+                        del self._armed[key]
+                        fn = armed[1]
+                    else:
+                        self._cond.wait(fire_at - now)
+                        continue
+                else:
+                    self._cond.wait()
+                    continue
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                log.error("timing-wheel callback crashed", error=repr(e))
 
 
 @dataclass
@@ -58,6 +170,19 @@ class _Entry:
     fired: bool = False  # leader: already covered by a published manifest
     kind: str = "sign"
     took_over: bool = False  # deputy already re-fired this entry once
+    # SLO lane + absolute deadline (monotonic clock). inf = no deadline,
+    # which keeps every legacy positional construction un-sheddable.
+    deadline_at: float = float("inf")
+    lane: str = wire.PRIORITY_BULK
+
+    def fill_rank(self) -> Tuple[int, float, float]:
+        """Batch-fill order: interactive lane first, then oldest deadline,
+        then arrival."""
+        return (
+            0 if self.lane == wire.PRIORITY_INTERACTIVE else 1,
+            self.deadline_at,
+            self.added_at,
+        )
 
 
 def _key_participants(key: Tuple) -> Tuple:
@@ -104,9 +229,14 @@ class BatchSigningScheduler:
         self,
         node: Node,
         transport: Transport,
-        window_s: float = 0.05,
-        max_batch: int = 1024,
-        manifest_timeout_s: float = 2.0,
+        window_s: Optional[float] = None,
+        max_batch: Optional[int] = None,
+        manifest_timeout_s: Optional[float] = None,
+        default_deadline_ms: Optional[int] = None,
+        max_queue_depth: Optional[int] = None,
+        decline_cap: Optional[int] = None,
+        batch_patience_s: Optional[float] = None,
+        metrics: Optional[MetricsRegistry] = None,
         on_fallback: Optional[Callable[[wire.SignTxMessage, str], None]] = None,
         on_tx_done: Optional[Callable[[str, str], None]] = None,
         on_tx_released: Optional[Callable[[str, str], None]] = None,
@@ -120,11 +250,36 @@ class BatchSigningScheduler:
         on_rs_released: Optional[Callable[[str, str], None]] = None,
         claim_rs: Optional[Callable[[str, str], bool]] = None,
     ):
+        from ..config import get_config
+
+        cfg = get_config()
         self.node = node
         self.transport = transport
-        self.window_s = window_s
-        self.max_batch = max_batch
-        self.manifest_timeout_s = manifest_timeout_s
+        # every knob: explicit argument wins, else the config value (which
+        # itself defaults to the historical constants)
+        self.window_s = window_s if window_s is not None else cfg.batch_window_s
+        self.max_batch = (
+            max_batch if max_batch is not None else cfg.batch_max_batch
+        )
+        self.manifest_timeout_s = (
+            manifest_timeout_s
+            if manifest_timeout_s is not None
+            else cfg.batch_manifest_timeout_s
+        )
+        self.default_deadline_ms = (
+            default_deadline_ms
+            if default_deadline_ms is not None
+            else cfg.batch_deadline_ms
+        )
+        self.max_queue_depth = (
+            max_queue_depth
+            if max_queue_depth is not None
+            else cfg.batch_max_queue_depth
+        )
+        self.decline_cap = (
+            decline_cap if decline_cap is not None else cfg.batch_decline_cap
+        )
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.on_fallback = on_fallback  # per-session path (consumer wires it)
         # lifecycle callbacks into the consumer's dedup bookkeeping
         self.on_tx_done = on_tx_done or (lambda w, t: None)
@@ -151,8 +306,10 @@ class BatchSigningScheduler:
         # (sign/reshare runners hand off to a Session and return; the
         # claims stay owned until that session's _prune)
         self._live_claims: Dict[str, set] = {}
-        self._timers: Dict[Tuple, threading.Timer] = {}  # leader windows +
-        # follower fallbacks, keyed ("win"|"fb", bucket)
+        # ONE timing-wheel thread serves every window, liveness fallback,
+        # deadline sweep, and decline expiry — keys ("win"|"fb"|"dl", bucket)
+        # and ("decl", session_id)
+        self._wheel = _TimingWheel(name=f"batch-wheel-{node.node_id}")
         self._sessions: List[Session] = []
         self.batches_run = 0  # engine-dispatch diagnostic (tests assert ≪ N)
         # GG18 exponent domains (None = production defaults); tests with
@@ -162,11 +319,48 @@ class BatchSigningScheduler:
         # party can spend minutes in XLA compiles or DLN verification, so
         # a busy (not gone) peer must not trip the 3x3s transport budget
         # or the 20s hello deadline
-        self.batch_patience_s = 900.0
-        self._decline_responders: Dict[str, Tuple] = {}
-        # secp material digests are constant per (wallet, epoch) — cache so
-        # a request burst doesn't re-load/re-hash the share per tx
-        self._digest_cache: Dict[Tuple[str, str, int], str] = {}
+        self.batch_patience_s = (
+            batch_patience_s
+            if batch_patience_s is not None
+            else cfg.batch_patience_s
+        )
+        # session_id -> pubsub subscription, insertion-ordered so the cap
+        # evicts the OLDEST responder (its peers have had the longest to
+        # hear the decline); expiry timers live on the wheel
+        self._decline_responders: "OrderedDict[str, object]" = OrderedDict()
+        # secp material digests are constant per (wallet, epoch) — LRU cache
+        # so a request burst costs one share load, not one per tx, and a
+        # long-lived node serving many wallets stays bounded
+        self._digest_cache: "OrderedDict[Tuple[str, str, int], str]" = (
+            OrderedDict()
+        )
+        # intake timestamps for end-to-end latency: (kind, wallet, tx) ->
+        # monotonic submit time, popped at done/shed (bounded FIFO)
+        self._intake_ts: "OrderedDict[Tuple[str, str, str], float]" = (
+            OrderedDict()
+        )
+        self._shed_seq = itertools.count()  # distinct shed idempotency keys
+        # authoritative per-lane buffered-entry counts (under self._lock);
+        # the gauges mirror them for snapshots
+        self._depth_n: Dict[str, int] = {lane: 0 for lane in wire.PRIORITIES}
+        # per-lane depth gauges + shared counters, created eagerly so a
+        # snapshot shows zeros instead of missing series
+        m = self.metrics
+        self._m_depth = {
+            lane: m.gauge(f"scheduler.queue_depth.{lane}")
+            for lane in wire.PRIORITIES
+        }
+        self._m_submitted = m.counter("scheduler.submitted_total")
+        self._m_shed = m.counter("scheduler.shed_total")
+        self._m_shed_bp = m.counter("scheduler.shed_backpressure_total")
+        self._m_shed_dl = m.counter("scheduler.shed_deadline_total")
+        self._m_batches = m.counter("scheduler.batches_fired_total")
+        self._m_fill = m.histogram("scheduler.batch_fill_ratio")
+        self._m_age = m.histogram("scheduler.dispatch_age_s")
+        self._m_takeover = m.counter("scheduler.deputy_takeover_total")
+        self._m_fallback = m.counter("scheduler.fallback_total")
+        self._m_e2e = m.histogram("scheduler.e2e_latency_s")
+        self._m_decl_evict = m.counter("scheduler.declines_evicted_total")
         self._sub = transport.pubsub.subscribe(
             wire.TOPIC_BATCH_MANIFEST, self._on_manifest_raw
         )
@@ -175,14 +369,11 @@ class BatchSigningScheduler:
     def close(self) -> None:
         self._closed = True
         self._sub.unsubscribe()
+        self._wheel.close()
         with self._lock:
-            for t in self._timers.values():
-                t.cancel()
-            self._timers.clear()
             for s in self._sessions:
                 s.close()
-            for sub, t in self._decline_responders.values():
-                t.cancel()
+            for sub in self._decline_responders.values():
                 try:
                     sub.unsubscribe()
                 except Exception:  # noqa: BLE001
@@ -209,7 +400,12 @@ class BatchSigningScheduler:
             # The digest is constant per (wallet, epoch) — cached, so a
             # burst of txs costs one share load, not one per tx.
             ck = (msg.key_type, msg.wallet_id, info.epoch)
-            dig = self._digest_cache.get(ck)
+            # LOCKED read (concurrent submits on the transport pool mutate
+            # this dict) + LRU touch so hot wallets stay resident
+            with self._lock:
+                dig = self._digest_cache.get(ck)
+                if dig is not None:
+                    self._digest_cache.move_to_end(ck)
             if dig is None:
                 from ..protocol.ecdsa.batch_signing import (
                     quorum_material_digest,
@@ -222,10 +418,8 @@ class BatchSigningScheduler:
                 if share.epoch != info.epoch:
                     return False  # mid-reshare — per-session path retries
                 dig = quorum_material_digest(share)
-                # one live epoch per wallet: evict superseded epochs so a
-                # long-lived node serving many rotations stays bounded.
-                # Under the lock: concurrent submit() callbacks insert
-                # while this iterates (transport handler thread pool)
+                # one live epoch per wallet: evict superseded epochs; the
+                # LRU cap bounds the cache even across millions of wallets
                 with self._lock:
                     stale = [
                         k for k in self._digest_cache
@@ -234,12 +428,16 @@ class BatchSigningScheduler:
                     for k in stale:
                         del self._digest_cache[k]
                     self._digest_cache[ck] = dig
+                    while len(self._digest_cache) > _DIGEST_CACHE_CAP:
+                        self._digest_cache.popitem(last=False)
             if not dig:
                 return False  # no GG18 aux → per-session path
             extra = (dig,)
         key = _bucket_key(info) + (msg.key_type,) + extra
         leader = self._acting_leader(info.participant_peer_ids)
-        return self._buffer_entry(key, _Entry(msg, reply_topic), leader)
+        return self._buffer_entry(
+            key, self._mk_entry(msg, reply_topic, "sign"), leader
+        )
 
     def submit_keygen(self, msg: wire.GenerateKeyMessage) -> bool:
         """Buffer a verified wallet-creation request for batched DKG
@@ -252,7 +450,7 @@ class BatchSigningScheduler:
             return False
         key = ("kg", tuple(self.node.peer_ids), self._threshold())
         leader = self._acting_leader(self.node.peer_ids)
-        return self._buffer_entry(key, _Entry(msg, "", kind="kg"), leader)
+        return self._buffer_entry(key, self._mk_entry(msg, "", "kg"), leader)
 
     def submit_reshare(self, msg: wire.ResharingMessage) -> bool:
         """Buffer a verified resharing request for batched rotation
@@ -266,7 +464,24 @@ class BatchSigningScheduler:
             info.threshold, info.epoch, msg.new_threshold,
         )
         leader = self._acting_leader(info.participant_peer_ids)
-        return self._buffer_entry(key, _Entry(msg, "", kind="rs"), leader)
+        return self._buffer_entry(key, self._mk_entry(msg, "", "rs"), leader)
+
+    def _mk_entry(self, msg, reply_topic: str, kind: str) -> _Entry:
+        """Stamp the SLO lane + absolute deadline onto a fresh entry.
+        ``deadline_ms`` 0 on the wire means "server default"; keygen
+        commands carry no SLO fields and always take the defaults."""
+        deadline_ms = getattr(msg, "deadline_ms", 0) or self.default_deadline_ms
+        lane = getattr(msg, "priority", wire.PRIORITY_BULK)
+        if lane not in wire.PRIORITIES:
+            lane = wire.PRIORITY_BULK
+        deadline_at = (
+            time.monotonic() + deadline_ms / 1000.0
+            if deadline_ms > 0
+            else float("inf")
+        )
+        return _Entry(
+            msg, reply_topic, kind=kind, deadline_at=deadline_at, lane=lane
+        )
 
     def _acting_leader(self, candidates) -> str:
         """Manifest leadership is RANK-based, not static: the smallest
@@ -285,34 +500,187 @@ class BatchSigningScheduler:
         return (live or cand)[0]
 
     def _buffer_entry(self, key: Tuple, entry: _Entry, leader: str) -> bool:
-        """Shared intake: append to the bucket, fire/arm the leader window,
-        arm the bucket-level liveness fallback."""
+        """Shared intake: depth-bounded append to the bucket, continuous
+        fire (at max_batch) or window arm, bucket-level liveness fallback,
+        deadline sweep. Returns True when the request is HANDLED — which
+        includes an honest refusal (shed): the caller must not route a
+        shed request down the per-session path, that would defeat the
+        backpressure bound."""
+        fire_after = False
+        with self._lock:
+            if self._closed:
+                return False
+            self._m_submitted.inc()
+            over_depth = sum(self._depth_n.values()) >= self.max_queue_depth
+        if over_depth:
+            # bounded intake: refuse NOW, loudly. Claim released, a
+            # retryable error event published, reply inbox answered —
+            # never a silent drop. (Outside the lock: the release
+            # callback re-enters the consumer's bookkeeping.)
+            self._shed(entry, "queue depth exceeded", backpressure=True)
+            return True
         with self._lock:
             if self._closed:
                 return False
             self._buckets.setdefault(key, []).append(entry)
+            self._note_depth(entry.lane, +1)
+            ek = _entry_key(entry.kind, entry.msg)
+            ts_key = (entry.kind, ek[0], ek[1])
+            self._intake_ts[ts_key] = entry.added_at
+            while len(self._intake_ts) > _INTAKE_TS_CAP:
+                self._intake_ts.popitem(last=False)
             if self.node.node_id == leader:
                 unfired = sum(1 for e in self._buckets[key] if not e.fired)
                 if unfired >= self.max_batch:
-                    self._fire(key)
-                elif ("win", key) not in self._timers:
-                    t = threading.Timer(self.window_s, self._fire, (key,))
-                    t.daemon = True
-                    t.start()
-                    self._timers[("win", key)] = t
-            if ("fb", key) not in self._timers:
-                # ONE bucket-level liveness timer (re-armed while entries
-                # remain), not one thread per request. The leader arms it
-                # too: entries stay bucketed until its own manifest loops
-                # back through pub/sub, so a lost manifest degrades to the
-                # per-session path instead of stranding the dedup claims.
-                t = threading.Timer(
-                    self.manifest_timeout_s, self._fallback_sweep, (key,)
-                )
-                t.daemon = True
-                t.start()
-                self._timers[("fb", key)] = t
+                    fire_after = True
+                else:
+                    self._wheel.schedule_if_absent(
+                        ("win", key), self.window_s,
+                        lambda: self._fire(key),
+                    )
+            # ONE bucket-level liveness task (re-armed while entries
+            # remain), not one thread per request. The leader arms it
+            # too: entries stay bucketed until its own manifest loops
+            # back through pub/sub, so a lost manifest degrades to the
+            # per-session path instead of stranding the dedup claims.
+            self._wheel.schedule_if_absent(
+                ("fb", key), self.manifest_timeout_s,
+                lambda: self._fallback_sweep(key),
+            )
+            if entry.deadline_at != float("inf"):
+                self._arm_deadline_locked(key, entry.deadline_at)
+        if fire_after:
+            # continuous batching: drain every full chunk ready right now
+            # (the remainder waits for the window or the next submit)
+            self._fire(key, only_full=True)
         return True
+
+    def _note_depth(self, lane: str, delta: int) -> None:
+        """Caller holds self._lock."""
+        n = self._depth_n.get(lane, 0) + delta
+        self._depth_n[lane] = max(0, n)
+        g = self._m_depth.get(lane)
+        if g is not None:
+            g.set(self._depth_n[lane])
+
+    def _arm_deadline_locked(self, key: Tuple, deadline_at: float) -> None:
+        """Arm (or pull earlier) the bucket's deadline sweep. Caller holds
+        self._lock. The wheel key is per-bucket: one task per bucket, not
+        one per entry."""
+        delay = max(0.0, deadline_at - time.monotonic())
+        wk = ("dl", key)
+        if not self._wheel.schedule_if_absent(
+            wk, delay, lambda: self._deadline_sweep(key)
+        ):
+            # already armed — only replace if this deadline is sooner;
+            # the sweep itself re-arms to the next-soonest survivor
+            bucket = self._buckets.get(key, [])
+            soonest = min(
+                (e.deadline_at for e in bucket), default=float("inf")
+            )
+            if deadline_at <= soonest:
+                self._wheel.schedule(
+                    wk, delay, lambda: self._deadline_sweep(key)
+                )
+
+    def _deadline_sweep(self, key: Tuple) -> None:
+        """Shed every buffered entry whose deadline passed (the batch it
+        would join could no longer meet the SLO), then re-arm for the
+        next-soonest survivor."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return
+            bucket = self._buckets.get(key, [])
+            expired = [e for e in bucket if e.deadline_at <= now]
+            bucket[:] = [e for e in bucket if e.deadline_at > now]
+            for e in expired:
+                self._note_depth(e.lane, -1)
+            nxt = min((e.deadline_at for e in bucket), default=float("inf"))
+            if nxt != float("inf"):
+                self._wheel.schedule(
+                    ("dl", key), max(0.0, nxt - now),
+                    lambda: self._deadline_sweep(key),
+                )
+        for e in expired:
+            self._shed(e, "deadline expired before dispatch")
+
+    # -- honest shedding -----------------------------------------------------
+
+    def _shed(self, e: _Entry, reason: str,
+              backpressure: bool = False) -> None:
+        """Refuse one request honestly: publish a *retryable* error event
+        (distinct idempotency key — a later retry's result must not dedupe
+        against it), answer the reply inbox, release the dedup claim, and
+        count it. Runs OUTSIDE self._lock — the release callback re-enters
+        the consumer's bookkeeping (its own lock)."""
+        self._m_shed.inc()
+        (self._m_shed_bp if backpressure else self._m_shed_dl).inc()
+        ek = _entry_key(e.kind, e.msg)
+        self._observe_e2e(e.kind, ek)
+        seq = next(self._shed_seq)
+        msg = e.msg
+        try:
+            if e.kind == "kg":
+                ev = wire.KeygenSuccessEvent(
+                    wallet_id=msg.wallet_id, ecdsa_pub_key="",
+                    eddsa_pub_key="", result_type=wire.RESULT_ERROR,
+                    error_reason=reason, retryable=True,
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_KEYGEN_RESULT}.{msg.wallet_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=f"{msg.wallet_id}-shed-{seq}",
+                )
+                self.on_kg_released(msg.wallet_id)
+            elif e.kind == "rs":
+                ev = wire.ResharingSuccessEvent(
+                    wallet_id=msg.wallet_id,
+                    new_threshold=msg.new_threshold,
+                    key_type=msg.key_type, pub_key="",
+                    result_type=wire.RESULT_ERROR, error_reason=reason,
+                    retryable=True,
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_RESHARING_RESULT}.{msg.wallet_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=(
+                        f"{msg.wallet_id}-{msg.key_type}-shed-{seq}"
+                    ),
+                )
+                self.on_rs_released(msg.key_type, msg.wallet_id)
+            else:
+                ev = wire.SigningResultEvent(
+                    result_type=wire.RESULT_ERROR,
+                    wallet_id=msg.wallet_id, tx_id=msg.tx_id,
+                    network_internal_code=msg.network_internal_code,
+                    error_reason=reason, retryable=True,
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=f"{msg.tx_id}-shed-{seq}",
+                )
+                if e.reply_topic:
+                    # consume the durable delivery: the refusal IS the
+                    # answer; the client owns the retry (fresh tx id)
+                    self.transport.pubsub.publish(e.reply_topic, b"ERR")
+                self.on_tx_released(msg.wallet_id, msg.tx_id)
+        except Exception as err:  # noqa: BLE001
+            log.warn("shed notification failed (transport closing?)",
+                     wallet=getattr(msg, "wallet_id", "?"), error=repr(err))
+        log.warn("request shed", kind=e.kind, lane=e.lane, reason=reason,
+                 wallet=getattr(msg, "wallet_id", "?"),
+                 node=self.node.node_id)
+
+    def _observe_e2e_locked(self, kind: str, ek: Tuple[str, str]) -> None:
+        t0 = self._intake_ts.pop((kind, ek[0], ek[1]), None)
+        if t0 is not None:
+            self._m_e2e.observe(time.monotonic() - t0)
+
+    def _observe_e2e(self, kind: str, ek: Tuple[str, str]) -> None:
+        with self._lock:
+            self._observe_e2e_locked(kind, ek)
 
     def _threshold(self) -> int:
         from ..config import get_config
@@ -362,55 +730,84 @@ class BatchSigningScheduler:
         sub = self.transport.pubsub.subscribe(topic, on_raw)
 
         def expire():
-            sub.unsubscribe()
             with self._lock:
-                self._decline_responders.pop(session_id, None)
+                s = self._decline_responders.pop(session_id, None)
+            if s is not None:
+                s.unsubscribe()
 
-        t = threading.Timer(self.batch_patience_s, expire)
-        t.daemon = True
-        t.start()
+        evicted = []
         with self._lock:
             if self._closed:
-                t.cancel()
                 sub.unsubscribe()
                 return
-            self._decline_responders[session_id] = (sub, t)
+            self._decline_responders[session_id] = sub
+            # cap concurrent responders: a burst of refused batches must
+            # not park one subscription each for the full patience window.
+            # Evict the OLDEST (its decline has been broadcast longest);
+            # a late hello to an evicted session goes unanswered and fails
+            # at the asker's hello deadline instead — degraded, not wrong.
+            while len(self._decline_responders) > self.decline_cap:
+                old_sid, old_sub = self._decline_responders.popitem(last=False)
+                self._wheel.cancel(("decl", old_sid))
+                evicted.append(old_sub)
+                self._m_decl_evict.inc()
+        self._wheel.schedule(("decl", session_id), self.batch_patience_s,
+                             expire)
+        for old_sub in evicted:
+            try:
+                old_sub.unsubscribe()
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- leader: manifest emission ------------------------------------------
 
-    def _fire(self, key: Tuple) -> None:
-        """Publish a manifest covering the bucket's unfired entries. The
-        entries STAY in the bucket (marked fired) until the manifest loops
-        back through _on_manifest_raw, which removes them and hands their
-        dedup claims to the batch — the same path followers take, so the
-        leader's claims can never be stranded by the old pop-and-forget."""
-        with self._lock:
-            t = self._timers.pop(("win", key), None)
-            if t:
-                t.cancel()
-            entries = [
-                e for e in self._buckets.get(key, []) if not e.fired
-            ][: self.max_batch]
-            for e in entries:
-                e.fired = True
-        if not entries:
-            return
-        kind = entries[0].kind
-        batch_id = secrets.token_hex(8)
-        requests = [
-            {"msg": e.msg.to_json(), "reply": e.reply_topic} for e in entries
-        ]
-        body = _manifest_body(batch_id, self.node.node_id, requests, kind)
-        manifest = {
-            "batch_id": batch_id,
-            "leader": self.node.node_id,
-            "requests": requests,
-            "kind": kind,
-            "sig": self.node.identity.sign_raw(body).hex(),
-        }
-        self.transport.pubsub.publish(
-            wire.TOPIC_BATCH_MANIFEST, json.dumps(manifest).encode()
-        )
+    def _fire(self, key: Tuple, only_full: bool = False) -> None:
+        """Publish manifests covering the bucket's unfired entries, filled
+        interactive-lane-first / oldest-deadline-first and drained in
+        max_batch chunks (continuous batching: every full chunk goes now;
+        with ``only_full`` the sub-max remainder waits for its window).
+        The entries STAY in the bucket (marked fired) until the manifest
+        loops back through _on_manifest_raw, which removes them and hands
+        their dedup claims to the batch — the same path followers take, so
+        the leader's claims can never be stranded by the old
+        pop-and-forget."""
+        while True:
+            now = time.monotonic()
+            with self._lock:
+                self._wheel.cancel(("win", key))
+                unfired = [
+                    e for e in self._buckets.get(key, []) if not e.fired
+                ]
+                if not unfired or (only_full
+                                   and len(unfired) < self.max_batch):
+                    return
+                unfired.sort(key=_Entry.fill_rank)
+                entries = unfired[: self.max_batch]
+                for e in entries:
+                    e.fired = True
+                self._m_batches.inc()
+                self._m_fill.observe(len(entries) / self.max_batch)
+                for e in entries:
+                    self._m_age.observe(now - e.added_at)
+            kind = entries[0].kind
+            batch_id = secrets.token_hex(8)
+            requests = [
+                {"msg": e.msg.to_json(), "reply": e.reply_topic}
+                for e in entries
+            ]
+            body = _manifest_body(batch_id, self.node.node_id, requests, kind)
+            manifest = {
+                "batch_id": batch_id,
+                "leader": self.node.node_id,
+                "requests": requests,
+                "kind": kind,
+                "sig": self.node.identity.sign_raw(body).hex(),
+            }
+            self.transport.pubsub.publish(
+                wire.TOPIC_BATCH_MANIFEST, json.dumps(manifest).encode()
+            )
+            if len(entries) < self.max_batch:
+                return  # bucket drained below a full chunk
 
     def _fallback_sweep(self, key: Tuple) -> None:
         """Follower liveness, with deputy escalation: when the acting
@@ -423,11 +820,23 @@ class BatchSigningScheduler:
         now = time.monotonic()
         stale: List[_Entry] = []
         takeover: List[_Entry] = []
+        expired: List[_Entry] = []
         with self._lock:
-            self._timers.pop(("fb", key), None)
             if self._closed:
                 return
             bucket = self._buckets.get(key, [])
+            # Deadline gate FIRST: an entry whose SLO already expired is
+            # shed retryably, never re-fired — a deputy taking over a dead
+            # leader's backlog must not double-fire work whose client has
+            # given up (the leader's original manifest may still be in
+            # flight; two manifests for a live entry are idempotent, but
+            # an expired one only wastes a batch slot and risks a
+            # confusing late success).
+            expired = [e for e in bucket if e.deadline_at <= now]
+            if expired:
+                bucket[:] = [e for e in bucket if e.deadline_at > now]
+                for e in expired:
+                    self._note_depth(e.lane, -1)
             # Escalation schedule: at age T the acting leader (deputy,
             # once the registry has marked the old leader dead) re-fires
             # the entries under its own manifest; everyone else waits 2T
@@ -453,12 +862,16 @@ class BatchSigningScheduler:
                 and now - e.added_at >= (T if e.took_over else 2 * T)
             ]
             bucket[:] = [e for e in bucket if e not in stale]
+            for e in stale:
+                self._note_depth(e.lane, -1)
             if bucket:
-                t = threading.Timer(T, self._fallback_sweep, (key,))
-                t.daemon = True
-                t.start()
-                self._timers[("fb", key)] = t
+                self._wheel.schedule(
+                    ("fb", key), T, lambda: self._fallback_sweep(key)
+                )
+        for e in expired:
+            self._shed(e, "deadline expired awaiting manifest")
         if takeover:
+            self._m_takeover.inc()
             log.warn(
                 "batch leader timed out — deputy taking over manifest",
                 node=self.node.node_id, entries=len(takeover),
@@ -466,6 +879,7 @@ class BatchSigningScheduler:
             )
             self._fire(key)
         for e in stale:
+            self._m_fallback.inc()
             log.warn("batch manifest timeout — per-session fallback",
                      wallet=e.msg.wallet_id, kind=e.kind,
                      node=self.node.node_id)
@@ -608,6 +1022,7 @@ class BatchSigningScheduler:
                     k = _entry_key(e.kind, e.msg)
                     if e.kind == kind and k in covered:
                         inherited.append(k)
+                        self._note_depth(e.lane, -1)
                     else:
                         kept.append(e)
                 bucket[:] = kept
@@ -854,6 +1269,7 @@ class BatchSigningScheduler:
             )
             if _entry_key("kg", msg) in owned:
                 self.on_kg_done(wid)
+            self._observe_e2e("kg", _entry_key("kg", msg))
         log.info("batched DKG complete", batch=batch_id, wallets=B,
                  node=node.node_id)
 
@@ -1021,6 +1437,7 @@ class BatchSigningScheduler:
                     )
                 if _entry_key("rs", msg) in owned:
                     self.on_rs_done(kt, wid)
+                self._observe_e2e("rs", _entry_key("rs", msg))
             log.info("batched reshare complete", batch=batch_id,
                      wallets=len(reqs), node=node.node_id)
             _prune()
@@ -1183,6 +1600,7 @@ class BatchSigningScheduler:
                     )
                 if (msg.wallet_id, msg.tx_id) in owned_set:
                     self.on_tx_done(msg.wallet_id, msg.tx_id)
+                self._observe_e2e("sign", (msg.wallet_id, msg.tx_id))
             log.info("batch signed", batch=batch_id, size=len(reqs),
                      node=node.node_id)
             _prune()

@@ -43,11 +43,15 @@ class EventConsumer:
         gc_interval_s: float = GC_INTERVAL_S,
         batch_signing: bool = False,
         batch_window_s: float = 0.05,
+        metrics=None,
     ):
+        from ..utils.metrics import MetricsRegistry
+
         self.node = node
         self.transport = transport
         self.session_timeout_s = session_timeout_s
         self.gc_interval_s = gc_interval_s
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._sessions: Dict[str, list] = {}  # dedup key -> [Session]
         self._claim_ts: Dict[str, float] = {}  # dedup key -> claim time
         self._claim_meta: Dict[str, tuple] = {}  # ("sign", msg) for GC
@@ -61,6 +65,7 @@ class EventConsumer:
 
             self.scheduler = BatchSigningScheduler(
                 node, transport, window_s=batch_window_s,
+                metrics=self.metrics,
                 on_fallback=self._batch_fallback,
                 on_tx_done=lambda w, t: self._finish(f"{w}-{t}"),
                 on_tx_released=lambda w, t: self._release(f"{w}-{t}"),
@@ -102,6 +107,27 @@ class EventConsumer:
         # on_error callback, which may re-enter our bookkeeping
         for s in doomed:
             s.close()
+
+    # -- health surface ------------------------------------------------------
+
+    def health(self) -> dict:
+        """JSON-ready operational snapshot: live session/claim counts plus
+        every metric in the registry (the scheduler's lane depths, shed
+        counters, latency histograms). The daemon publishes this to the
+        control plane; LocalCluster aggregates it for tests and soaks."""
+        with self._lock:
+            live_sessions = sum(len(ss) for ss in self._sessions.values())
+            claims = len(self._claim_ts)
+        out = {
+            "node": self.node.node_id,
+            "live_sessions": live_sessions,
+            "dedup_claims": claims,
+            "batch_signing": self.scheduler is not None,
+            "metrics": self.metrics.snapshot(),
+        }
+        if self.scheduler is not None:
+            out["batches_run"] = self.scheduler.batches_run
+        return out
 
     # -- crash recovery (boot-time WAL resume) ------------------------------
 

@@ -286,6 +286,10 @@ class FaultPlan:
 
 # protocol traffic topic globs (wire.py topic composers)
 PROTOCOL_TOPICS = ("keygen:*", "sign:*", "resharing:*")
+# batched-session traffic (batch_scheduler.py session ids): batched signing,
+# batched DKG, batched resharing — NOT covered by PROTOCOL_TOPICS, which
+# predate the batch scheduler. The load-soak plan targets these.
+BATCH_TOPICS = ("bsign:*", "bdkg:*", "brs:*")
 
 
 def _protocol_rules(seed: int, p_drop: float, jitter: Tuple[float, float]):
@@ -323,5 +327,19 @@ def named_plan(name: str, seed: int,
             rules.append(duplicate(p=0.2, topic=t, channel="queue"))
             rules.append(reorder(p=0.3, topic=t, channel="pubsub",
                                  window_ms=50.0 * scale))
+        return FaultPlan(seed, rules)
+    if name == "batch-chaos":
+        # the load-soak plan: jitter on every batched-session round plus
+        # acked-unicast losses (the sender's retry budget absorbs them —
+        # latency degrades, correctness must not), and jitter on the
+        # manifest fan-out so window/fallback timing is exercised. Result
+        # topics are left clean: the soak's accounting needs every
+        # submitted request to produce SOME terminal event.
+        rules = []
+        for t in BATCH_TOPICS:
+            rules.append(drop(p=0.05, topic=t, channel="direct"))
+            rules.append(delay(ms=(5.0 * scale, 60.0 * scale), topic=t))
+        rules.append(delay(ms=(5.0 * scale, 40.0 * scale),
+                           topic="mpc:batch_manifest"))
         return FaultPlan(seed, rules)
     raise KeyError(f"unknown named plan {name!r}")

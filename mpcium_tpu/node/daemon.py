@@ -12,6 +12,7 @@ import getpass
 import json
 import signal
 import threading
+import time
 from pathlib import Path
 
 from ..config import check_required, get_config, init_config
@@ -166,6 +167,28 @@ def run_node(
             log.warn("WAL resume scan failed", node=name, error=repr(e))
     signing = SigningConsumer(transport)
     signing.run()
+    # health surface: periodically publish the consumer's operational
+    # snapshot (live sessions, dedup claims, scheduler lane depths, shed
+    # counters, latency percentiles) to the control plane under
+    # ``health/<name>`` — the same KV operators already watch for peer
+    # liveness, so `kv get health/node0` is the whole monitoring story
+    health_stop = threading.Event()
+
+    def _health_loop():
+        while not health_stop.wait(10.0):
+            try:
+                snap = consumer.health()
+                snap["ts"] = time.time()
+                control_kv.put(
+                    f"health/{name}",
+                    json.dumps(snap, sort_keys=True).encode(),
+                )
+            except Exception as e:  # noqa: BLE001 — never kill the beat
+                log.warn("health publish failed", node=name, error=repr(e))
+
+    threading.Thread(
+        target=_health_loop, name=f"health-{name}", daemon=True
+    ).start()
     log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")
 
     if not block:
@@ -180,6 +203,7 @@ def run_node(
     signal.signal(signal.SIGTERM, _sig)
     stop.wait()
     log.info("shutting down", node=name)
+    health_stop.set()
     signing.close()
     consumer.close()
     registry.resign()

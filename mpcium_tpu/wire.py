@@ -16,6 +16,14 @@ from typing import Any, Dict, List, Optional
 KEY_TYPE_SECP256K1 = "secp256k1"
 KEY_TYPE_ED25519 = "ed25519"
 
+# deadline lanes (SLO-aware continuous batching). ``priority`` selects the
+# dispatch lane; ``deadline_ms`` is the client's end-to-end latency budget
+# (0 ⇒ take the server-side config default). Both are omitted from signing
+# bytes and JSON when default so legacy messages stay byte-identical.
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BULK = "bulk"
+PRIORITIES = (PRIORITY_INTERACTIVE, PRIORITY_BULK)
+
 
 def canonical_json(obj: Any) -> bytes:
     """Deterministic JSON: sorted keys, no whitespace, UTF-8."""
@@ -125,20 +133,32 @@ class SignTxMessage:
     tx: bytes
 
     signature: bytes = b""
+    # SLO hints: 0/bulk are the wire defaults and are omitted from signing
+    # bytes + JSON, so legacy signed messages keep their exact byte shape.
+    deadline_ms: int = 0
+    priority: str = PRIORITY_BULK
+
+    def _slo_fields(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.deadline_ms:
+            out["deadline_ms"] = self.deadline_ms
+        if self.priority != PRIORITY_BULK:
+            out["priority"] = self.priority
+        return out
 
     def raw(self) -> bytes:
-        return canonical_json(
-            {
-                "key_type": self.key_type,
-                "wallet_id": self.wallet_id,
-                "network_internal_code": self.network_internal_code,
-                "tx_id": self.tx_id,
-                "tx": self.tx.hex(),
-            }
-        )
+        body = {
+            "key_type": self.key_type,
+            "wallet_id": self.wallet_id,
+            "network_internal_code": self.network_internal_code,
+            "tx_id": self.tx_id,
+            "tx": self.tx.hex(),
+        }
+        body.update(self._slo_fields())
+        return canonical_json(body)
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "key_type": self.key_type,
             "wallet_id": self.wallet_id,
             "network_internal_code": self.network_internal_code,
@@ -146,6 +166,8 @@ class SignTxMessage:
             "tx": self.tx.hex(),
             "signature": self.signature.hex(),
         }
+        out.update(self._slo_fields())
+        return out
 
     @classmethod
     def from_json(cls, d) -> "SignTxMessage":
@@ -156,6 +178,8 @@ class SignTxMessage:
             tx_id=d["tx_id"],
             tx=bytes.fromhex(d["tx"]),
             signature=bytes.fromhex(d.get("signature", "")),
+            deadline_ms=int(d.get("deadline_ms", 0)),
+            priority=d.get("priority", PRIORITY_BULK),
         )
 
 
@@ -167,23 +191,35 @@ class ResharingMessage:
     new_threshold: int
     key_type: str
     signature: bytes = b""
+    deadline_ms: int = 0
+    priority: str = PRIORITY_BULK
+
+    def _slo_fields(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.deadline_ms:
+            out["deadline_ms"] = self.deadline_ms
+        if self.priority != PRIORITY_BULK:
+            out["priority"] = self.priority
+        return out
 
     def raw(self) -> bytes:
-        return canonical_json(
-            {
-                "wallet_id": self.wallet_id,
-                "new_threshold": self.new_threshold,
-                "key_type": self.key_type,
-            }
-        )
+        body = {
+            "wallet_id": self.wallet_id,
+            "new_threshold": self.new_threshold,
+            "key_type": self.key_type,
+        }
+        body.update(self._slo_fields())
+        return canonical_json(body)
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "wallet_id": self.wallet_id,
             "new_threshold": self.new_threshold,
             "key_type": self.key_type,
             "signature": self.signature.hex(),
         }
+        out.update(self._slo_fields())
+        return out
 
     @classmethod
     def from_json(cls, d) -> "ResharingMessage":
@@ -192,6 +228,8 @@ class ResharingMessage:
             new_threshold=int(d["new_threshold"]),
             key_type=d["key_type"],
             signature=bytes.fromhex(d.get("signature", "")),
+            deadline_ms=int(d.get("deadline_ms", 0)),
+            priority=d.get("priority", PRIORITY_BULK),
         )
 
 
@@ -216,6 +254,7 @@ class KeygenSuccessEvent:
     eddsa_pub_key: str  # hex (compressed Edwards)
     result_type: str = RESULT_SUCCESS
     error_reason: str = ""
+    retryable: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -226,6 +265,8 @@ class KeygenSuccessEvent:
         if self.result_type != RESULT_SUCCESS:
             out["result_type"] = self.result_type
             out["error_reason"] = self.error_reason
+            if self.retryable:
+                out["retryable"] = True
         return out
 
     @classmethod
@@ -236,6 +277,7 @@ class KeygenSuccessEvent:
             eddsa_pub_key=d.get("eddsa_pub_key", ""),
             result_type=d.get("result_type", RESULT_SUCCESS),
             error_reason=d.get("error_reason", ""),
+            retryable=bool(d.get("retryable", False)),
         )
 
 
@@ -253,9 +295,13 @@ class SigningResultEvent:
     s: str = ""  # hex, ECDSA
     signature_recovery: str = ""  # hex byte, ECDSA
     signature: str = ""  # hex, EdDSA (64-byte R||s)
+    # honest shedding: True ⇒ the request was refused before protocol work
+    # (backpressure, deadline expiry) and a verbatim retry is safe. Omitted
+    # from JSON when False so the reference-pinned success shape is unchanged.
+    retryable: bool = False
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "result_type": self.result_type,
             "error_reason": self.error_reason,
             "is_timeout": self.is_timeout,
@@ -267,6 +313,9 @@ class SigningResultEvent:
             "signature_recovery": self.signature_recovery,
             "signature": self.signature,
         }
+        if self.retryable:
+            out["retryable"] = True
+        return out
 
     @classmethod
     def from_json(cls, d) -> "SigningResultEvent":
@@ -281,6 +330,7 @@ class SigningResultEvent:
             s=d.get("s", ""),
             signature_recovery=d.get("signature_recovery", ""),
             signature=d.get("signature", ""),
+            retryable=bool(d.get("retryable", False)),
         )
 
 
@@ -295,6 +345,7 @@ class ResharingSuccessEvent:
     pub_key: str  # hex
     result_type: str = RESULT_SUCCESS
     error_reason: str = ""
+    retryable: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -306,6 +357,8 @@ class ResharingSuccessEvent:
         if self.result_type != RESULT_SUCCESS:
             out["result_type"] = self.result_type
             out["error_reason"] = self.error_reason
+            if self.retryable:
+                out["retryable"] = True
         return out
 
     @classmethod
@@ -317,6 +370,7 @@ class ResharingSuccessEvent:
             pub_key=d.get("pub_key", ""),
             result_type=d.get("result_type", RESULT_SUCCESS),
             error_reason=d.get("error_reason", ""),
+            retryable=bool(d.get("retryable", False)),
         )
 
 

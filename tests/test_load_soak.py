@@ -1,0 +1,85 @@
+"""Tier-1 smoke soak (ISSUE 6): a seconds-long miniature of
+scripts/load_soak.py that still proves the serving invariants.
+
+The real soak (`make soak`, committed SOAK_*.json) runs minutes of
+bursty traffic at full chaos scale; this smoke keeps the cluster tiny
+and the batch-chaos plan scaled down so it fits the tier-1 budget, but
+it is NOT a happy-path run: the fault plan stays active (loss on acked
+unicasts + jitter) and the queue depth is set below the burst size, so the
+backpressure shed → retryable error event → client retry with a fresh
+tx id path is exercised end to end through the full cluster, and the
+closing-of-the-books invariant is asserted the same way the CLI
+enforces it:
+
+    submitted == succeeded + shed + failed   (and pending == 0)
+"""
+
+import pytest
+
+from mpcium_tpu.soak import SoakConfig, run_soak
+
+pytestmark = pytest.mark.soak
+
+
+def test_smoke_soak_sheds_retries_and_closes_the_books(tmp_path):
+    cfg = SoakConfig(
+        n_nodes=3,
+        threshold=1,
+        n_wallets=3,
+        root_dir=str(tmp_path),
+        n_sign=6,
+        burst_size=6,          # one burst...
+        burst_gap_s=0.1,
+        seed=1234,
+        interactive_fraction=0.5,
+        interactive_deadline_ms=300_000,
+        bulk_deadline_ms=600_000,
+        max_retries=3,
+        retry_backoff_s=0.4,   # > batch_window_s, so retries land in a
+                               # drained queue instead of re-shedding
+        chaos="batch-chaos",   # fault plan ACTIVE, scaled down: drops on
+        chaos_seed=7,          # acked unicasts + light jitter; the books
+        chaos_scale=0.25,      # must still close exactly
+        batch_window_s=0.25,
+        batch_max_batch=1024,
+        batch_max_queue_depth=3,  # ...< burst: forces backpressure sheds
+        manifest_timeout_s=120.0,
+        wait_timeout_s=420.0,
+    )
+    report = run_soak(cfg)
+
+    # The one bug class the harness exists to catch: silent drops.
+    out = report["outcomes"]
+    assert report["accounting_ok"], report
+    assert out["pending"] == 0
+    assert out["submitted"] == 6
+    assert out["submitted"] == (
+        out["succeeded"] + out["shed"] + out["failed"])
+
+    # Shed is retryable, not fatal: every request ultimately signs.
+    assert out["succeeded"] == 6
+    assert out["failed"] == 0
+    assert report["by_kind"]["sign"]["succeeded"] == 6
+
+    # The burst overflowed the bounded queue, loudly, and the client's
+    # retry (fresh tx id) recovered each shed request.
+    sched = report["scheduler"]
+    assert sched["shed_backpressure"] >= 1
+    assert out["retries"] >= 1
+    assert sched["batches_fired"] >= 2  # original batch + retry batch
+
+    # Per-node metric consistency: shed reasons partition shed_total,
+    # and nothing is left sitting in a lane at the end.
+    for node, snap in sched["per_node"].items():
+        c, g = snap["counters"], snap["gauges"]
+        assert c["scheduler.shed_total"] == (
+            c["scheduler.shed_backpressure_total"]
+            + c["scheduler.shed_deadline_total"]), (node, c)
+        assert g["scheduler.queue_depth.interactive"] == 0, (node, g)
+        assert g["scheduler.queue_depth.bulk"] == 0, (node, g)
+        # intake counts every attempt, including retries
+        assert c["scheduler.submitted_total"] >= 6, (node, c)
+
+    # Latency is measured from the ORIGINAL submission for every
+    # request, retried or not — all six have a number.
+    assert report["latency_ms"]["overall"]["count"] == 6

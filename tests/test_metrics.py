@@ -1,0 +1,86 @@
+"""utils.metrics: the process-local counters/gauges/histograms behind the
+scheduler's SLO observability (no cluster, no engine)."""
+import threading
+
+import pytest
+
+from mpcium_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+def test_counter_monotonic_and_threadsafe():
+    c = Counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    threads = [
+        threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 5 + 4000
+
+
+def test_gauge_set_inc_dec():
+    g = Gauge("g")
+    assert g.value == 0.0
+    g.set(7)
+    g.inc(3)
+    g.dec(4)
+    assert g.value == 6.0
+
+
+def test_histogram_percentiles_and_summary():
+    h = Histogram("h")
+    for v in range(1, 101):  # 1..100
+        h.observe(v)
+    assert h.count == 100
+    assert h.sum == sum(range(1, 101))
+    assert h.min == 1 and h.max == 100
+    assert h.percentile(50) == pytest.approx(50, abs=1)
+    assert h.percentile(99) == pytest.approx(99, abs=1)
+    s = h.summary()
+    assert s["count"] == 100
+    assert s["p50"] == pytest.approx(50, abs=1)
+    assert s["p99"] == pytest.approx(99, abs=1)
+    assert s["mean"] == pytest.approx(50.5)
+
+
+def test_histogram_reservoir_bounded():
+    h = Histogram("h", reservoir=64)
+    for v in range(10_000):
+        h.observe(v)
+    # exact aggregates survive the bounded reservoir…
+    assert h.count == 10_000
+    assert h.max == 9_999 and h.min == 0
+    # …while percentiles come from the most recent window
+    assert h.percentile(50) >= 9_000
+
+
+def test_registry_reuses_and_type_checks():
+    r = MetricsRegistry()
+    c = r.counter("x.total")
+    assert r.counter("x.total") is c
+    with pytest.raises(TypeError):
+        r.gauge("x.total")
+    r.gauge("x.depth").set(3)
+    r.histogram("x.lat").observe(0.25)
+
+    snap = r.snapshot()
+    assert snap["counters"]["x.total"] == 0.0
+    assert snap["gauges"]["x.depth"] == 3.0
+    assert snap["histograms"]["x.lat"]["count"] == 1
+    # snapshots are plain JSON-serializable data
+    import json
+
+    json.dumps(snap)
