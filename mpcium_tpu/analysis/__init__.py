@@ -1,0 +1,44 @@
+"""mpclint — project-native static analysis (ISSUE 7).
+
+An AST-based, rule-plugin analyzer that mechanically enforces the
+invariants this codebase keeps re-learning the hard way:
+
+- **secret hygiene** (MPL1xx): key shares, seeds, OT pads, nonces and
+  AEAD keys must never flow into log lines, exception messages or
+  ``repr``; secret byte comparisons go through ``hmac.compare_digest``.
+- **determinism** (MPL2xx): no wall-clock/PRNG/entropy calls and no
+  dict-order iteration over peer sets inside fault-plan decision paths
+  or protocol round functions — replay and WAL bit-identity depend on it.
+- **lock discipline** (MPL3xx): fields declared via the ``@locked_by``
+  annotation may only be written under their lock; the cross-module
+  lock-acquisition graph must stay acyclic.
+- **jit/retrace hazards** (MPL4xx): no host syncs (``np.*``,
+  ``.item()``, scalar coercions) or traced-value branching inside
+  ``jax.jit``-compiled bodies.
+- **wire/thread hygiene** (MPL5xx): every wire dataclass round-trips
+  through ``to_json``/``from_json`` and carries a version field; every
+  ``threading.Thread``/``Timer`` is daemonized or registered with the
+  conftest leak-checker.
+- **hygiene** (MPL6xx): the ruff-class defects (bare ``except:``,
+  mutable default args, unused module-level imports) — enforced natively
+  because the container has no ruff.
+
+See STATIC_ANALYSIS.md for the annotation registry, suppression syntax
+(``# mpclint: disable=<rule> — reason``) and the fail-closed baseline
+workflow.
+"""
+from __future__ import annotations
+
+from .baseline import Baseline, BaselineError, load_baseline
+from .core import Finding, LintContext, LintResult, lint_paths, run_lint
+
+__all__ = [
+    "Baseline",
+    "BaselineError",
+    "Finding",
+    "LintContext",
+    "LintResult",
+    "lint_paths",
+    "load_baseline",
+    "run_lint",
+]

@@ -1,0 +1,189 @@
+"""MPL4xx — jit/retrace hazards.
+
+The perf work in PRs 2 and 5 is predicated on jit bodies staying
+device-resident: one host sync inside a compiled region serializes the
+pipeline, and one trace-dependent Python branch silently recompiles per
+batch shape. Both are invisible in tests (CPU jit hides the cost) and
+expensive on the accelerator, so they are linted instead.
+
+MPL401  host-side numpy / .item() / scalar coercion of a traced value
+        inside a ``@jax.jit`` body. Trace-time constants (e.g. a domain
+        tag built with np.frombuffer from a bytes literal) are legal but
+        must be baselined with a justification saying so — the baseline
+        is where "this is trace-time" claims get reviewed.
+MPL402  Python ``if``/``while`` on a non-static parameter inside a jit
+        body — shape/dtype/ndim attribute tests are exempt (static under
+        tracing); everything else either crashes or retraces.
+
+Detection is lexical: a function is "a jit body" when its decorator list
+contains ``jax.jit``/``jit`` or ``functools.partial(jax.jit, ...)``;
+static parameters come from ``static_argnames``/``static_argnums``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Tuple
+
+from ..core import Finding, LintContext, ParsedFile, Rule, dotted_name
+
+_SCOPES = ("mpcium_tpu/engine/", "mpcium_tpu/ops/", "mpcium_tpu/protocol/")
+
+_HOST_ROOTS = ("np.", "numpy.")
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_COERCIONS = {"int", "float", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.startswith(s) for s in _SCOPES)
+
+
+def _jit_static_params(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> Optional[Set[str]]:
+    """None when ``fn`` is not jit-decorated; otherwise the set of
+    parameter names marked static."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name in ("jax.jit", "jit"):
+            return set()
+        if isinstance(dec, ast.Call):
+            cname = dotted_name(dec.func)
+            inner = dotted_name(dec.args[0]) if dec.args else ""
+            if cname.endswith("partial") and inner in ("jax.jit", "jit"):
+                static: Set[str] = set()
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        for c in ast.walk(kw.value):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                c.value, str
+                            ):
+                                static.add(c.value)
+                    elif kw.arg == "static_argnums":
+                        for c in ast.walk(kw.value):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                c.value, int
+                            ):
+                                if 0 <= c.value < len(params):
+                                    static.add(params[c.value])
+                return static
+            if cname in ("jax.jit", "jit"):
+                return set()
+    return None
+
+
+def _jit_functions(
+    pf: ParsedFile,
+) -> Iterator[Tuple[ast.FunctionDef | ast.AsyncFunctionDef, Set[str], Set[str]]]:
+    """(fn, traced_params, static_params) for every jit body in the file."""
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        static = _jit_static_params(node)
+        if static is None:
+            continue
+        params = {
+            a.arg
+            for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+        }
+        yield node, params - static - {"self"}, static
+
+
+class HostSyncInJit(Rule):
+    id = "MPL401"
+    summary = "no host numpy / .item() / scalar coercion inside jit bodies"
+
+    def applies(self, rel: str) -> bool:
+        return _in_scope(rel)
+
+    def check(self, pf: ParsedFile, ctx: LintContext) -> Iterator[Finding]:
+        for fn, traced, _static in _jit_functions(pf):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                offense = ""
+                if name and name.startswith(_HOST_ROOTS):
+                    offense = name
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_METHODS
+                ):
+                    offense = f".{node.func.attr}()"
+                elif name in _COERCIONS and node.args:
+                    arg_ids = {
+                        n.id
+                        for n in ast.walk(node.args[0])
+                        if isinstance(n, ast.Name)
+                    }
+                    if arg_ids & traced:
+                        offense = f"{name}(<traced>)"
+                if not offense:
+                    continue
+                yield Finding(
+                    rule=self.id,
+                    path=pf.rel,
+                    line=node.lineno,
+                    symbol=f"{pf.symbol_of(fn)}.{fn.name}".lstrip("."),
+                    key=offense,
+                    message=(
+                        f"{offense} inside jit body {fn.name!r} — host sync "
+                        f"or per-trace host work; hoist out of the compiled "
+                        f"region (baseline it only if it is provably "
+                        f"trace-time-constant)"
+                    ),
+                )
+
+
+class TracedBranchInJit(Rule):
+    id = "MPL402"
+    summary = "no Python branching on traced values inside jit bodies"
+
+    def applies(self, rel: str) -> bool:
+        return _in_scope(rel)
+
+    def _traced_names_in_test(self, test: ast.AST, traced: Set[str]) -> Set[str]:
+        """Names of traced params used *by value* in a test. Attribute
+        access limited to shape/ndim/dtype/size is static and exempt."""
+        hits: Set[str] = set()
+
+        def walk(node: ast.AST) -> None:
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _STATIC_ATTRS
+            ):
+                return  # x.shape[...] — static under tracing
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname == "len" or fname == "isinstance":
+                    return
+            if isinstance(node, ast.Name) and node.id in traced:
+                hits.add(node.id)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(test)
+        return hits
+
+    def check(self, pf: ParsedFile, ctx: LintContext) -> Iterator[Finding]:
+        for fn, traced, _static in _jit_functions(pf):
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                hits = self._traced_names_in_test(node.test, traced)
+                for ident in sorted(hits):
+                    yield Finding(
+                        rule=self.id,
+                        path=pf.rel,
+                        line=node.lineno,
+                        symbol=f"{pf.symbol_of(fn)}.{fn.name}".lstrip("."),
+                        key=ident,
+                        message=(
+                            f"Python branch on traced value {ident!r} in jit "
+                            f"body {fn.name!r} — use jnp.where/lax.cond, or "
+                            f"mark the argument static"
+                        ),
+                    )

@@ -68,6 +68,7 @@ from ..protocol.base import KeygenShare, ProtocolError
 from ..protocol.eddsa.batch_signing import BatchedEDDSASigningParty
 from ..transport.api import Transport
 from ..utils import log
+from ..utils.annotations import locked_by
 from ..utils.metrics import MetricsRegistry
 
 _DIGEST_CACHE_CAP = 4096  # (key_type, wallet, epoch) -> material digest LRU
@@ -222,6 +223,17 @@ def _manifest_body(
     )
 
 
+@locked_by(
+    "_lock",
+    "_buckets",
+    "_batch_claims",
+    "_live_claims",
+    "_sessions",
+    "_decline_responders",
+    "_digest_cache",
+    "_intake_ts",
+    "_depth_n",
+)
 class BatchSigningScheduler:
     """Per-node scheduler instance (every node runs one)."""
 
@@ -522,9 +534,21 @@ class BatchSigningScheduler:
         with self._lock:
             if self._closed:
                 return False
+            ek = _entry_key(entry.kind, entry.msg)
+            d = self._dedup_str(entry.kind, ek)
+            if self._batch_claims.get(d, 0) > 0 or any(
+                d in claims for claims in self._live_claims.values()
+            ):
+                # Late intake: pub/sub ordering across topics is not
+                # guaranteed, so the manifest covering this very request
+                # can be processed BEFORE the request itself arrives here.
+                # A batch/session already owns the claim and will answer
+                # the same reply inbox; buffering a duplicate would strand
+                # an orphaned lane entry (nonzero depth gauge) until a
+                # sweep collects it. Absorb it instead.
+                return True
             self._buckets.setdefault(key, []).append(entry)
             self._note_depth(entry.lane, +1)
-            ek = _entry_key(entry.kind, entry.msg)
             ts_key = (entry.kind, ek[0], ek[1])
             self._intake_ts[ts_key] = entry.added_at
             while len(self._intake_ts) > _INTAKE_TS_CAP:
@@ -673,7 +697,7 @@ class BatchSigningScheduler:
                  wallet=getattr(msg, "wallet_id", "?"),
                  node=self.node.node_id)
 
-    def _observe_e2e_locked(self, kind: str, ek: Tuple[str, str]) -> None:
+    def _observe_e2e_locked(self, kind: str, ek: Tuple[str, str]) -> None:  # mpclint: holds=_lock
         t0 = self._intake_ts.pop((kind, ek[0], ek[1]), None)
         if t0 is not None:
             self._m_e2e.observe(time.monotonic() - t0)
@@ -1031,7 +1055,7 @@ class BatchSigningScheduler:
                 self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
         return inherited
 
-    def _forget_locked(self, kind: str, keys) -> None:
+    def _forget_locked(self, kind: str, keys) -> None:  # mpclint: holds=_lock
         """Decrement (and drop at zero) the refcounts for ``keys``.
         Caller holds self._lock."""
         for k in keys:

@@ -26,7 +26,7 @@ from typing import Dict, Optional
 
 from .. import wire
 from ..node.node import Node, NotEnoughParticipants
-from ..node.session import RetryableSessionError, Session
+from ..node.session import RetryableSessionError
 from ..transport.api import Transport
 from ..utils import log
 

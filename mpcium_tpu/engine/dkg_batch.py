@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import functools
 import secrets
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -21,10 +21,9 @@ sessions; the `ok` masks make padding harmless).
 """
 from __future__ import annotations
 
-import functools
 import hashlib
 import secrets
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

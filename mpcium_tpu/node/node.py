@@ -10,11 +10,9 @@ uses ``ecdsa:<walletID>`` / ``eddsa:<walletID>`` store keys
 from __future__ import annotations
 
 import json
-import threading
 from typing import Callable, Optional, Sequence
 
 from .. import wire
-from ..core import hostmath as hm
 from ..core.paillier import PreParams, gen_preparams
 from ..identity.identity import IdentityStore
 from ..protocol.base import KeygenShare, ProtocolError
@@ -228,9 +226,12 @@ class Node:
         # epoch is also baked into the session id and topics below, so nodes
         # on different epochs can never exchange rounds even transiently.
         if share.epoch != info.epoch:
+            # interpolate the epoch numbers only, never the share object
+            # (its repr would ride the traceback into logs) — MPL102
+            epoch_have = share.epoch
             raise NotEnoughParticipants(
                 f"reshare in progress for {wallet_id!r}: share epoch "
-                f"{share.epoch} != keyinfo epoch {info.epoch}"
+                f"{epoch_have} != keyinfo epoch {info.epoch}"
             )
         epoch_tag = f"{tx_id}~e{share.epoch}" if share.epoch else tx_id
         session_id = f"sign:{wire._kt(key_type)}:{wallet_id}:{epoch_tag}"
@@ -301,9 +302,10 @@ class Node:
             self.load_share(key_type, wallet_id) if is_old else None
         )
         if old_share is not None and old_share.epoch != info.epoch:
+            epoch_have = old_share.epoch
             raise NotEnoughParticipants(
                 f"reshare in progress for {wallet_id!r}: share epoch "
-                f"{old_share.epoch} != keyinfo epoch {info.epoch}"
+                f"{epoch_have} != keyinfo epoch {info.epoch}"
             )
         session_id = f"resharing:{wire._kt(key_type)}:{wallet_id}:e{info.epoch}"
         party = ResharingParty(

@@ -17,13 +17,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from ..identity.identity import IdentityStore
 from ..protocol.base import PartyBase, ProtocolError, RoundMsg
 from ..store.session_wal import SessionWALWriter
 from ..transport.api import Transport, TransportError
 from ..utils import log
+from ..utils.annotations import locked_by
 from ..wire import Envelope
 
 HELLO_ROUND = "__hello__"
@@ -63,6 +64,18 @@ class RetryableSessionError(SessionError):
     philosophy (event_consumer.go:276-280)."""
 
 
+# the PR 4 `_started`-published-before-`start()` race is exactly the shape
+# this declaration turns into a lint error (MPL301)
+@locked_by(
+    "_lock",
+    "_started",
+    "_start_claimed",
+    "_failed",
+    "_hellos",
+    "_buffer",
+    "_sent_raw",
+    "_finished",
+)
 class Session:
     """One protocol run bound to topics.
 
@@ -361,7 +374,7 @@ class Session:
             elif to == requester:
                 self._out_q.put((to, raw))
 
-    def _checkpoint(self, out: Sequence[RoundMsg]) -> None:
+    def _checkpoint(self, out: Sequence[RoundMsg]) -> None:  # mpclint: holds=_lock
         """Journal party state + this step's outputs. Called under the
         session lock, BEFORE the outputs are routed: a resumed party must
         re-send the exact payloads peers may already hold, never re-derive

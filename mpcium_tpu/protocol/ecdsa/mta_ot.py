@@ -54,7 +54,6 @@ work uses; the leading axis is the concurrent-session batch.
 """
 from __future__ import annotations
 
-import functools
 import hashlib
 import os
 import secrets as _secrets

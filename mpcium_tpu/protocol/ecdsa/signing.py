@@ -25,7 +25,7 @@ from the aggregated VSS commitments, which is what the MtAwc check pins.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 from ...core import hostmath as hm
 from ...core.paillier import PaillierPrivateKey, PaillierPublicKey

@@ -25,7 +25,7 @@ culprit attributed (same abort semantics as the per-session protocol).
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import jax.numpy as jnp
 import numpy as np

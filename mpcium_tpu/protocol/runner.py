@@ -10,13 +10,15 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, List
 
-from .base import PartyBase, RoundMsg
+from .base import PartyBase
 
 
 def run_protocol(parties: Dict[str, PartyBase], max_msgs: int = 100_000) -> None:
     """Drive all parties until done. Raises on protocol errors/stalls."""
     queue: deque = deque()
-    for party in parties.values():
+    # sorted: every member must walk the peer set identically (dict order
+    # is insertion order, which differs per node) — MPL202
+    for _pid, party in sorted(parties.items()):
         for m in party.start():
             queue.append(m)
     delivered = 0
@@ -26,13 +28,13 @@ def run_protocol(parties: Dict[str, PartyBase], max_msgs: int = 100_000) -> None
         if delivered > max_msgs:
             raise RuntimeError("protocol did not converge (message storm)")
         targets: List[PartyBase] = (
-            [p for pid, p in parties.items() if pid != msg.from_id]
+            [p for pid, p in sorted(parties.items()) if pid != msg.from_id]
             if msg.is_broadcast
             else [parties[msg.to]]
         )
         for t in targets:
             for out in t.receive(msg):
                 queue.append(out)
-    stalled = [pid for pid, p in parties.items() if not p.done]
+    stalled = [pid for pid, p in sorted(parties.items()) if not p.done]
     if stalled:
         raise RuntimeError(f"protocol stalled; undone parties: {stalled}")

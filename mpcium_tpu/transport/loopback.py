@@ -24,8 +24,8 @@ import itertools
 import threading
 import time
 from collections import defaultdict, deque
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
 
 from .api import (
     DeadLetterHandler,
@@ -35,7 +35,6 @@ from .api import (
     Permanent,
     PubSub,
     QueueConfig,
-    QueueHandler,
     Subscription,
     Transport,
     TransportError,
@@ -105,10 +104,22 @@ class LoopbackFabric:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = 10.0) -> None:
         self._closed = True
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._qpool.shutdown(wait=False, cancel_futures=True)
+        # close() is a teardown barrier: the workers must actually be gone
+        # when it returns (the soak smoke asserts zero leaked threads), but
+        # a handler wedged on a dead peer must not hang close() forever,
+        # and a handler that itself triggers close() must not join its own
+        # thread — hence the bounded, self-excluding join.
+        me = threading.current_thread()
+        deadline = time.monotonic() + join_timeout_s
+        for pool in (self._pool, self._qpool):
+            for t in list(getattr(pool, "_threads", ())):
+                if t is me:
+                    continue
+                t.join(max(0.0, deadline - time.monotonic()))
 
     def drain(self, timeout_s: float = 60.0) -> None:
         """Block until no handler is in flight (tests)."""

@@ -61,7 +61,7 @@ import os
 import socket
 import threading
 import time
-from collections import defaultdict, deque
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 try:
@@ -763,57 +763,67 @@ class BrokerServer:
         a standby's snapshot cut can never fall between them (a message
         missing from both snapshot and stream would be silently lost on
         failover despite the publisher's fsynced ack)."""
-        reps: list = []
-        with self._lock:
-            # TTL check first (see _enq_ts comment in __init__): an
-            # expired message must neither enter pending/inflight nor be
-            # streamed to standbys as live — it takes the dead-letter
-            # path below. The replica list read and the pending/inflight
-            # entry stay inside this ONE critical section so a standby's
-            # snapshot cut can never fall between them.
-            ts = self._enq_ts.setdefault(mid, time.time())
-            expired = (
-                self.queue_ttl_s > 0
-                and time.time() - ts > self.queue_ttl_s
-            )
-            if expired:
-                self._enq_ts.pop(mid, None)
-            else:
-                if rep_rec is not None:
-                    reps = [c for c in self._conns.values() if c.is_replica]
-                targets = [
-                    (c, sid)
-                    for c in self._conns.values()
-                    for sid, (kind, pat) in c.subs.items()
-                    if kind == "queue" and topic_matches(pat, topic)
-                ]
-                if not targets:
-                    self._pending_q.append((topic, data_hex, deliveries, mid))
-                    self._pending_mids.add(mid)
-                    c = None
+        while True:
+            reps: list = []
+            with self._lock:
+                # TTL check first (see _enq_ts comment in __init__): an
+                # expired message must neither enter pending/inflight nor be
+                # streamed to standbys as live — it takes the dead-letter
+                # path below. The replica list read and the pending/inflight
+                # entry stay inside this ONE critical section so a standby's
+                # snapshot cut can never fall between them.
+                ts = self._enq_ts.setdefault(mid, time.time())
+                expired = (
+                    self.queue_ttl_s > 0
+                    and time.time() - ts > self.queue_ttl_s
+                )
+                if expired:
+                    self._enq_ts.pop(mid, None)
                 else:
-                    c, sid = targets[next(self._rr) % len(targets)]
-                    did = next(self._did)
-                    self._inflight[did] = (
-                        topic, data_hex, deliveries + 1, c.cid, mid
-                    )
-        if expired:
-            log.warn("queue message expired (no consumer within TTL)",
-                     topic=topic, mid=mid, ttl_s=self.queue_ttl_s)
-            self._journal_write({"j": "done", "mid": mid})
-            self._replicate({"j": "done", "mid": mid})
-            self._dead_letter(topic, data_hex, deliveries)
-            return
-        for r in reps:
-            r.send({"op": "rep", **rep_rec})
-        if c is None:
-            return
-        if not c.send(
-            {"op": "qmsg", "sid": sid, "did": did, "data": data_hex, "topic": topic}
-        ):
+                    if rep_rec is not None:
+                        reps = [c for c in self._conns.values() if c.is_replica]
+                    targets = [
+                        (c, sid)
+                        for c in self._conns.values()
+                        if c.alive
+                        for sid, (kind, pat) in c.subs.items()
+                        if kind == "queue" and topic_matches(pat, topic)
+                    ]
+                    if not targets:
+                        self._pending_q.append(
+                            (topic, data_hex, deliveries, mid))
+                        self._pending_mids.add(mid)
+                        c = None
+                    else:
+                        c, sid = targets[next(self._rr) % len(targets)]
+                        did = next(self._did)
+                        self._inflight[did] = (
+                            topic, data_hex, deliveries + 1, c.cid, mid
+                        )
+            if expired:
+                log.warn("queue message expired (no consumer within TTL)",
+                         topic=topic, mid=mid, ttl_s=self.queue_ttl_s)
+                self._journal_write({"j": "done", "mid": mid})
+                self._replicate({"j": "done", "mid": mid})
+                self._dead_letter(topic, data_hex, deliveries)
+                return
+            for r in reps:
+                r.send({"op": "rep", **rep_rec})
+            if c is None:
+                return
+            if c.send(
+                {"op": "qmsg", "sid": sid, "did": did,
+                 "data": data_hex, "topic": topic}
+            ):
+                return
+            # Dead target: send() marked the conn not-alive, so the next
+            # pass excludes it — the retry is bounded by the number of
+            # live-at-selection conns. (This used to recurse, which blew
+            # the stack during broker-failover churn when a batch of
+            # messages all re-routed off the same dying connection.)
             with self._lock:
                 self._inflight.pop(did, None)
-            self._queue_dispatch(topic, data_hex, deliveries, mid)
+            rep_rec = None
 
     def _flush_pending(self) -> None:
         with self._lock:

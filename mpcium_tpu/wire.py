@@ -10,8 +10,8 @@ themselves.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
 
 KEY_TYPE_SECP256K1 = "secp256k1"
 KEY_TYPE_ED25519 = "ed25519"
@@ -51,6 +51,10 @@ class Envelope:
     to: Optional[str] = None
     is_broadcast: bool = True
     signature: bytes = b""
+    # wire schema version. 0 is the v0 shape and is omitted from JSON (and
+    # never covered by signing bytes), so legacy signed envelopes stay
+    # byte-identical; bump only with a parser that handles both.
+    v: int = 0
 
     def marshal_for_signing(self) -> bytes:
         return canonical_json(
@@ -65,7 +69,7 @@ class Envelope:
         )
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "session_id": self.session_id,
             "round": self.round,
             "from": self.from_id,
@@ -74,6 +78,9 @@ class Envelope:
             "payload": self.payload,
             "signature": self.signature.hex(),
         }
+        if self.v:
+            out["v"] = self.v
+        return out
 
     @classmethod
     def from_json(cls, d: Dict[str, Any]) -> "Envelope":
@@ -85,6 +92,7 @@ class Envelope:
             to=d.get("to"),
             is_broadcast=d.get("is_broadcast", True),
             signature=bytes.fromhex(d.get("signature", "")),
+            v=int(d.get("v", 0)),
         )
 
     def encode(self) -> bytes:
@@ -106,18 +114,23 @@ class GenerateKeyMessage:
 
     wallet_id: str
     signature: bytes = b""
+    v: int = 0
 
     def raw(self) -> bytes:
         return self.wallet_id.encode()
 
     def to_json(self) -> Dict[str, Any]:
-        return {"wallet_id": self.wallet_id, "signature": self.signature.hex()}
+        out = {"wallet_id": self.wallet_id, "signature": self.signature.hex()}
+        if self.v:
+            out["v"] = self.v
+        return out
 
     @classmethod
     def from_json(cls, d) -> "GenerateKeyMessage":
         return cls(
             wallet_id=d["wallet_id"],
             signature=bytes.fromhex(d.get("signature", "")),
+            v=int(d.get("v", 0)),
         )
 
 
@@ -137,6 +150,8 @@ class SignTxMessage:
     # bytes + JSON, so legacy signed messages keep their exact byte shape.
     deadline_ms: int = 0
     priority: str = PRIORITY_BULK
+    # schema version, same omit-while-0 contract as the SLO fields
+    v: int = 0
 
     def _slo_fields(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -167,6 +182,8 @@ class SignTxMessage:
             "signature": self.signature.hex(),
         }
         out.update(self._slo_fields())
+        if self.v:
+            out["v"] = self.v
         return out
 
     @classmethod
@@ -180,6 +197,7 @@ class SignTxMessage:
             signature=bytes.fromhex(d.get("signature", "")),
             deadline_ms=int(d.get("deadline_ms", 0)),
             priority=d.get("priority", PRIORITY_BULK),
+            v=int(d.get("v", 0)),
         )
 
 
@@ -193,6 +211,7 @@ class ResharingMessage:
     signature: bytes = b""
     deadline_ms: int = 0
     priority: str = PRIORITY_BULK
+    v: int = 0
 
     def _slo_fields(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -219,6 +238,8 @@ class ResharingMessage:
             "signature": self.signature.hex(),
         }
         out.update(self._slo_fields())
+        if self.v:
+            out["v"] = self.v
         return out
 
     @classmethod
@@ -230,6 +251,7 @@ class ResharingMessage:
             signature=bytes.fromhex(d.get("signature", "")),
             deadline_ms=int(d.get("deadline_ms", 0)),
             priority=d.get("priority", PRIORITY_BULK),
+            v=int(d.get("v", 0)),
         )
 
 
@@ -255,6 +277,7 @@ class KeygenSuccessEvent:
     result_type: str = RESULT_SUCCESS
     error_reason: str = ""
     retryable: bool = False
+    v: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -267,6 +290,8 @@ class KeygenSuccessEvent:
             out["error_reason"] = self.error_reason
             if self.retryable:
                 out["retryable"] = True
+        if self.v:
+            out["v"] = self.v
         return out
 
     @classmethod
@@ -278,6 +303,7 @@ class KeygenSuccessEvent:
             result_type=d.get("result_type", RESULT_SUCCESS),
             error_reason=d.get("error_reason", ""),
             retryable=bool(d.get("retryable", False)),
+            v=int(d.get("v", 0)),
         )
 
 
@@ -299,6 +325,7 @@ class SigningResultEvent:
     # (backpressure, deadline expiry) and a verbatim retry is safe. Omitted
     # from JSON when False so the reference-pinned success shape is unchanged.
     retryable: bool = False
+    v: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -315,6 +342,8 @@ class SigningResultEvent:
         }
         if self.retryable:
             out["retryable"] = True
+        if self.v:
+            out["v"] = self.v
         return out
 
     @classmethod
@@ -331,6 +360,7 @@ class SigningResultEvent:
             signature_recovery=d.get("signature_recovery", ""),
             signature=d.get("signature", ""),
             retryable=bool(d.get("retryable", False)),
+            v=int(d.get("v", 0)),
         )
 
 
@@ -346,6 +376,7 @@ class ResharingSuccessEvent:
     result_type: str = RESULT_SUCCESS
     error_reason: str = ""
     retryable: bool = False
+    v: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -359,6 +390,8 @@ class ResharingSuccessEvent:
             out["error_reason"] = self.error_reason
             if self.retryable:
                 out["retryable"] = True
+        if self.v:
+            out["v"] = self.v
         return out
 
     @classmethod
@@ -371,6 +404,7 @@ class ResharingSuccessEvent:
             result_type=d.get("result_type", RESULT_SUCCESS),
             error_reason=d.get("error_reason", ""),
             retryable=bool(d.get("retryable", False)),
+            v=int(d.get("v", 0)),
         )
 
 

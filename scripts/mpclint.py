@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Run mpclint, the project-native static analyzer. See STATIC_ANALYSIS.md.
+
+    python scripts/mpclint.py               # full sweep, gated on baseline
+    python scripts/mpclint.py --list-rules
+    make lint                               # ruff + mypy (if present) + this
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from mpcium_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,11 +14,26 @@ enforces it:
     submitted == succeeded + shed + failed   (and pending == 0)
 """
 
+import threading
+
 import pytest
 
 from mpcium_tpu.soak import SoakConfig, run_soak
+from mpcium_tpu.utils.annotations import REGISTERED_THREAD_PREFIXES
 
 pytestmark = pytest.mark.soak
+
+
+def _foreign_threads():
+    """Live non-daemon threads other than the main thread and the
+    registered process-lifetime singletons (MPL502's runtime twin)."""
+    return [
+        t
+        for t in threading.enumerate()
+        if t is not threading.main_thread()
+        and not t.daemon
+        and not (t.name or "").startswith(REGISTERED_THREAD_PREFIXES)
+    ]
 
 
 def test_smoke_soak_sheds_retries_and_closes_the_books(tmp_path):
@@ -83,3 +98,10 @@ def test_smoke_soak_sheds_retries_and_closes_the_books(tmp_path):
     # Latency is measured from the ORIGINAL submission for every
     # request, retried or not — all six have a number.
     assert report["latency_ms"]["overall"]["count"] == 6
+
+    # Zero leaked threads: every worker the whole cluster+scheduler+chaos
+    # stack started must be gone (or daemon/registered) once the soak
+    # returns — the conftest leak fixture would catch this at session end,
+    # but asserting here pins the leak to the soak path.
+    leaked = _foreign_threads()
+    assert not leaked, [t.name for t in leaked]

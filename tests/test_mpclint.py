@@ -1,0 +1,70 @@
+"""Tier-1 mpclint gate: the full rule set over the whole package.
+
+This is `make lint`'s mpclint stage as a test: any non-baselined finding
+fails, any stale baseline entry fails (the baseline only shrinks), and
+the sweep must stay fast enough to live in tier-1.
+"""
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu.analysis import load_baseline, run_lint
+from mpcium_tpu.analysis.baseline import DEFAULT_BASELINE
+from mpcium_tpu.analysis.cli import main as mpclint_main
+
+pytestmark = pytest.mark.lint
+
+ROOT = Path(__file__).resolve().parents[1]
+MAX_BASELINE_ENTRIES = 15
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    t0 = time.monotonic()
+    result = run_lint(root=ROOT)
+    result.elapsed = time.monotonic() - t0
+    return result
+
+
+def test_package_parses_clean(sweep):
+    assert not sweep.parse_errors, sweep.parse_errors
+    # the whole package is in scope, not a subset
+    assert sweep.files_scanned > 60
+
+
+def test_no_new_findings(sweep):
+    baseline = load_baseline(ROOT / DEFAULT_BASELINE)
+    new, _grandfathered, stale = baseline.split(sweep.findings)
+    assert not new, "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, (
+        "stale baseline entries (delete them — the baseline only "
+        "shrinks):\n" + "\n".join(stale)
+    )
+
+
+def test_sweep_is_tier1_fast(sweep):
+    # generous bound: ~2s on the CI box; 30s keeps it honest under load
+    assert sweep.elapsed < 30, f"sweep took {sweep.elapsed:.1f}s"
+
+
+def test_baseline_is_small_and_justified():
+    baseline = load_baseline(ROOT / DEFAULT_BASELINE)
+    assert len(baseline.entries) <= MAX_BASELINE_ENTRIES
+    for fp, justification in baseline.entries.items():
+        assert fp.startswith("MPL"), fp
+        # load_baseline enforces non-empty; require a real sentence here
+        assert len(justification) > 20, (fp, justification)
+
+
+def test_cli_agrees(capsys):
+    assert mpclint_main([]) == 0
+    assert mpclint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    # one line per rule family member, ids are unique
+    ids = [line.split()[0] for line in out.strip().splitlines() if line]
+    assert len(ids) == len(set(ids)) >= 14

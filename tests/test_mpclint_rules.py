@@ -1,0 +1,540 @@
+"""Per-rule mpclint unit tests: positive + negative snippets per family,
+the PR 4 `_started` publish-before-start race as a regression snippet,
+suppression/annotation syntax, baseline mechanics, and the runtime side
+of the wire-version contract.
+"""
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu import wire
+from mpcium_tpu.analysis.baseline import Baseline, BaselineError, load_baseline
+from mpcium_tpu.analysis.core import Finding, LintContext, ParsedFile
+from mpcium_tpu.analysis.rules import all_rules
+from mpcium_tpu.analysis.rules.determinism import (
+    DictOrderIteration,
+    ForbiddenEntropyCall,
+)
+from mpcium_tpu.analysis.rules.hygiene import (
+    BareExcept,
+    MutableDefaultArg,
+    UnusedImport,
+)
+from mpcium_tpu.analysis.rules.jit_hazards import HostSyncInJit, TracedBranchInJit
+from mpcium_tpu.analysis.rules.lock_discipline import (
+    LockOrderInversion,
+    UnguardedLockedField,
+)
+from mpcium_tpu.analysis.rules.secret_hygiene import (
+    SecretCompare,
+    SecretInException,
+    SecretToLog,
+)
+from mpcium_tpu.analysis.rules.wire_thread import UnmanagedThread, WireVersionRoundTrip
+from mpcium_tpu.analysis.taxonomy import is_compare_sensitive, is_secret_name
+from mpcium_tpu.utils.annotations import locked_by
+
+pytestmark = pytest.mark.lint
+
+PROTO_REL = "mpcium_tpu/protocol/snippet.py"
+
+
+def lint(src: str, rules, rel: str = PROTO_REL):
+    """Run fresh rule instances over one dedented snippet."""
+    pf = ParsedFile(Path(rel), rel, textwrap.dedent(src))
+    ctx = LintContext([pf])
+    out = []
+    for rule in rules:
+        if rule.applies(rel):
+            out += [
+                f
+                for f in rule.check(pf, ctx)
+                if not pf.is_suppressed(f.rule, f.line)
+            ]
+    for rule in rules:
+        out += [
+            f
+            for f in rule.finalize(ctx)
+            if not pf.is_suppressed(f.rule, f.line)
+        ]
+    return out
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- taxonomy ---------------------------------------------------------------
+
+
+def test_taxonomy_secret_names():
+    for name in ("share", "old_share", "wal_key", "seed", "otk_pads", "sk"):
+        assert is_secret_name(name), name
+    for name in ("pub_key", "public_key", "wallet_id", "share_count", "tx_id",
+                 "secrets", "hashed_share"):
+        assert not is_secret_name(name), name
+    assert is_compare_sensitive("auth_tag")
+    assert is_compare_sensitive("share")
+    assert not is_compare_sensitive("wallet_id")
+
+
+def test_secret_annotation_registers_extra_names():
+    src = """
+    def f():
+        blob = derive()  # mpclint: secret
+        log.info("derived", blob=blob)
+    """
+    found = lint(src, [SecretToLog()])
+    assert rule_ids(found) == ["MPL101"]
+
+
+# -- MPL1xx secret hygiene --------------------------------------------------
+
+
+def test_secret_to_log_positive_and_negative():
+    bad = """
+    def f(share):
+        log.info("round done", share=share.hex())
+    """
+    assert rule_ids(lint(bad, [SecretToLog()])) == ["MPL101"]
+    ok = """
+    def f(share, wallet_id):
+        log.info("round done", wallet=wallet_id, n=1)
+    """
+    assert lint(ok, [SecretToLog()]) == []
+
+
+def test_secret_in_exception():
+    bad = """
+    def f(seed):
+        raise ValueError(f"bad seed {seed!r}")
+    """
+    assert rule_ids(lint(bad, [SecretInException()])) == ["MPL102"]
+    ok = """
+    def f(seed):
+        raise ValueError("bad seed (redacted)")
+    """
+    assert lint(ok, [SecretInException()]) == []
+
+
+def test_secret_compare():
+    bad = """
+    def f(tag, expect):
+        if tag != expect:
+            raise ValueError("bad mac")
+    """
+    assert rule_ids(lint(bad, [SecretCompare()])) == ["MPL103"]
+    ok = """
+    import hmac
+    def f(tag, expect):
+        if not hmac.compare_digest(tag, expect):
+            raise ValueError("bad mac")
+    """
+    assert lint(ok, [SecretCompare()]) == []
+    # non-sensitive compares don't fire
+    ok2 = """
+    def f(count, other):
+        return count == other
+    """
+    assert lint(ok2, [SecretCompare()]) == []
+
+
+# -- MPL2xx determinism -----------------------------------------------------
+
+
+def test_forbidden_entropy_scoped_to_protocol():
+    bad = """
+    import time
+    def decide():
+        return time.time()
+    """
+    assert rule_ids(lint(bad, [ForbiddenEntropyCall()])) == ["MPL201"]
+    # time.monotonic is allowed (duration measurement, not decisions)
+    ok = """
+    import time
+    def decide():
+        return time.monotonic()
+    """
+    assert lint(ok, [ForbiddenEntropyCall()]) == []
+    # out of scope: same code elsewhere in the package is not flagged
+    assert lint(bad, [ForbiddenEntropyCall()], rel="mpcium_tpu/utils/x.py") == []
+
+
+def test_dict_order_iteration():
+    bad = """
+    def route(peers):
+        for p in peers:
+            send(p)
+        return [p for p, v in peers.items()]
+    """
+    found = lint(bad, [DictOrderIteration()])
+    assert rule_ids(found) == ["MPL202"] and len(found) == 2
+    ok = """
+    def route(peers):
+        for p in sorted(peers):
+            send(p)
+    """
+    assert lint(ok, [DictOrderIteration()]) == []
+
+
+# -- MPL3xx lock discipline -------------------------------------------------
+
+
+def test_locked_field_pr4_started_race_regression():
+    # PR 4's bug: consumer published the session (checking `_started`)
+    # before start() ran — a write to the guarded flag outside the lock
+    bad = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def start(self):
+            self._started = True
+    """
+    found = lint(bad, [UnguardedLockedField()])
+    assert rule_ids(found) == ["MPL301"]
+    assert found[0].key == "_started"
+    ok = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def __init__(self):
+            self._started = False  # unpublished: exempt
+        def start(self):
+            with self._lock:
+                self._started = True
+        def _flip(self):  # mpclint: holds=_lock
+            self._started = True
+    """
+    assert lint(ok, [UnguardedLockedField()]) == []
+
+
+def test_locked_field_catches_container_mutation():
+    bad = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_buffer")
+    class S:
+        def push(self, m):
+            self._buffer.append(m)
+    """
+    assert rule_ids(lint(bad, [UnguardedLockedField()])) == ["MPL301"]
+
+
+def test_lock_order_inversion_cycle():
+    bad = """
+    class S:
+        def a(self):
+            with self._lock:
+                with self._cond:
+                    pass
+        def b(self):
+            with self._cond:
+                with self._lock:
+                    pass
+    """
+    assert rule_ids(lint(bad, [LockOrderInversion()])) == ["MPL302"]
+    # consistent global order: no cycle
+    ok = """
+    class S:
+        def a(self):
+            with self._lock:
+                with self._cond:
+                    pass
+        def b(self):
+            with self._lock:
+                with self._cond:
+                    pass
+    """
+    assert lint(ok, [LockOrderInversion()]) == []
+    # release-before-callback (the timing-wheel pattern) creates no edge
+    ok2 = """
+    class Wheel:
+        def run(self):
+            while True:
+                with self._cond:
+                    fn = self._pop()
+                fn()
+        def schedule(self):
+            with self._lock:
+                with self._cond:
+                    pass
+    """
+    assert lint(ok2, [LockOrderInversion()]) == []
+
+
+# -- MPL4xx jit hazards -----------------------------------------------------
+
+
+def test_host_sync_in_jit(tmp_path):
+    bad = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        tag = np.frombuffer(b"tag", dtype=np.uint8)
+        return x
+    """
+    found = lint(bad, [HostSyncInJit()], rel="mpcium_tpu/engine/x.py")
+    assert rule_ids(found) == ["MPL401"]
+    ok = """
+    import jax
+    import jax.numpy as jnp
+    @jax.jit
+    def f(x):
+        return jnp.zeros_like(x)
+    """
+    assert lint(ok, [HostSyncInJit()], rel="mpcium_tpu/engine/x.py") == []
+    # un-jitted host helpers may use numpy freely
+    ok2 = """
+    import numpy as np
+    def g(x):
+        return np.asarray(x)
+    """
+    assert lint(ok2, [HostSyncInJit()], rel="mpcium_tpu/engine/x.py") == []
+
+
+def test_traced_branch_in_jit():
+    bad = """
+    import jax
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert rule_ids(
+        lint(bad, [TracedBranchInJit()], rel="mpcium_tpu/ops/x.py")
+    ) == ["MPL402"]
+    # static args and shape tests are trace-time: fine
+    ok = """
+    import functools
+    import jax
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        if n > 2:
+            return x
+        if x.shape[0] > 4:
+            return -x
+        return x
+    """
+    assert lint(ok, [TracedBranchInJit()], rel="mpcium_tpu/ops/x.py") == []
+
+
+# -- MPL5xx wire & threads --------------------------------------------------
+
+
+def test_wire_version_rule():
+    bad = """
+    from dataclasses import dataclass
+    @dataclass
+    class PingMessage:
+        wallet_id: str
+    """
+    assert rule_ids(
+        lint(bad, [WireVersionRoundTrip()], rel="mpcium_tpu/wire.py")
+    ) == ["MPL501"]
+    ok = """
+    from dataclasses import dataclass
+    @dataclass
+    class PingMessage:
+        wallet_id: str
+        v: int = 0
+        def to_json(self):
+            out = {"wallet_id": self.wallet_id}
+            if self.v:
+                out["v"] = self.v
+            return out
+        @classmethod
+        def from_json(cls, d):
+            return cls(d["wallet_id"], v=int(d.get("v", 0)))
+    """
+    assert lint(ok, [WireVersionRoundTrip()], rel="mpcium_tpu/wire.py") == []
+    # only wire.py is in scope
+    assert lint(bad, [WireVersionRoundTrip()], rel="mpcium_tpu/soak.py") == []
+
+
+def test_unmanaged_thread():
+    bad = """
+    import threading
+    def go(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+    """
+    assert rule_ids(lint(bad, [UnmanagedThread()])) == ["MPL502"]
+    for ok in (
+        # constructor daemon
+        """
+        import threading
+        def go(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """,
+        # post-construction daemon (the Timer idiom)
+        """
+        import threading
+        def go(fn):
+            t = threading.Timer(1.0, fn)
+            t.daemon = True
+            t.start()
+        """,
+        # leak-checker-registered singleton
+        """
+        import threading
+        def go(fn):
+            threading.Thread(target=fn, name="ot-host-0").start()
+        """,
+    ):
+        assert lint(ok, [UnmanagedThread()]) == [], ok
+
+
+# -- MPL6xx hygiene ---------------------------------------------------------
+
+
+def test_hygiene_rules():
+    bad = """
+    import json
+    import os
+
+    def f(xs=[], m={}):
+        try:
+            return os.getpid()
+        except:
+            return None
+    """
+    found = lint(bad, [BareExcept(), MutableDefaultArg(), UnusedImport()])
+    assert rule_ids(found) == ["MPL601", "MPL602", "MPL603"]
+    keys = sorted(f.key for f in found if f.rule == "MPL602")
+    assert keys == ["m", "xs"]
+    unused = [f.key for f in found if f.rule == "MPL603"]
+    assert unused == ["json"]
+
+
+# -- suppression & fingerprints ---------------------------------------------
+
+
+def test_inline_suppression_with_reason():
+    src = """
+    def f():
+        try:
+            pass
+        except:  # mpclint: disable=MPL601 — probing optional backends
+            pass
+    """
+    assert lint(src, [BareExcept()]) == []
+
+
+def test_file_level_suppression():
+    src = """
+    # mpclint: disable-file=MPL601
+    def f():
+        try:
+            pass
+        except:
+            pass
+    """
+    assert lint(src, [BareExcept()]) == []
+
+
+def test_fingerprint_is_line_free():
+    a = Finding("MPL101", "p.py", 10, "f", "share", "m")
+    b = Finding("MPL101", "p.py", 99, "f", "share", "m")
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != Finding("MPL101", "p.py", 10, "g", "share", "m").fingerprint
+
+
+# -- baseline mechanics -----------------------------------------------------
+
+
+def test_baseline_split_and_fail_closed(tmp_path):
+    f1 = Finding("MPL101", "a.py", 1, "f", "share", "m")
+    f2 = Finding("MPL102", "b.py", 2, "g", "seed", "m")
+    b = Baseline(path=tmp_path / "b.json", entries={f1.fingerprint: "ok because"})
+    new, grandfathered, stale = b.split([f1, f2])
+    assert new == [f2] and grandfathered == [f1] and stale == []
+    # the grandfathered finding disappears -> its entry is stale -> fails
+    new, grandfathered, stale = b.split([f2])
+    assert stale == [f1.fingerprint]
+
+
+def test_baseline_load_rejects_bad_files(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text("{not json")
+    with pytest.raises(BaselineError):
+        load_baseline(p)
+    p.write_text(
+        '{"version": 1, "entries": [{"fingerprint": "MPL1:a::k", '
+        '"justification": "  "}]}'
+    )
+    with pytest.raises(BaselineError):
+        load_baseline(p)
+    # missing file = empty baseline, not an error
+    empty = load_baseline(tmp_path / "nope.json")
+    assert empty.entries == {}
+
+
+def test_all_rules_have_unique_ids_and_summaries():
+    rules = all_rules()
+    ids = [r.id for r in rules]
+    assert len(ids) == len(set(ids))
+    assert all(r.summary for r in rules)
+    assert len(rules) >= 14
+
+
+# -- runtime side of the wire-version contract ------------------------------
+
+
+WIRE_CASES = [
+    (wire.Envelope, dict(session_id="s", round="r1", from_id="a", payload={"x": 1})),
+    (wire.GenerateKeyMessage, dict(wallet_id="w")),
+    (
+        wire.SignTxMessage,
+        dict(key_type="secp256k1", wallet_id="w", network_internal_code="n",
+             tx_id="t", tx=b"ab"),
+    ),
+    (wire.ResharingMessage, dict(wallet_id="w", new_threshold=2, key_type="secp256k1")),
+    (wire.KeygenSuccessEvent, dict(wallet_id="w", ecdsa_pub_key="01", eddsa_pub_key="02")),
+    (wire.SigningResultEvent, dict(result_type="success", wallet_id="w", tx_id="t")),
+    (
+        wire.ResharingSuccessEvent,
+        dict(wallet_id="w", new_threshold=2, key_type="secp256k1", pub_key="03"),
+    ),
+]
+
+
+@pytest.mark.parametrize("cls,kw", WIRE_CASES, ids=[c.__name__ for c, _ in WIRE_CASES])
+def test_wire_version_round_trip(cls, kw):
+    legacy = cls(**kw)
+    assert cls.from_json(legacy.to_json()) == legacy
+    # v=0 is omitted: the v0 JSON shape (and signing bytes) are unchanged
+    assert "v" not in legacy.to_json()
+    vnext = cls(v=1, **kw)
+    assert vnext.to_json()["v"] == 1
+    assert cls.from_json(vnext.to_json()).v == 1
+
+
+def test_envelope_signing_bytes_ignore_version():
+    kw = dict(session_id="s", round="r1", from_id="a", payload={"x": 1})
+    assert (
+        wire.Envelope(**kw).marshal_for_signing()
+        == wire.Envelope(v=1, **kw).marshal_for_signing()
+    )
+
+
+# -- runtime side of @locked_by ---------------------------------------------
+
+
+def test_locked_by_runtime_registry_is_zero_cost():
+    @locked_by("_lock", "_a")
+    @locked_by("_lock", "_b")
+    @locked_by("_other", "_c")
+    class K:
+        pass
+
+    reg = K.__mpclint_locked_by__
+    assert set(reg["_lock"]) == {"_a", "_b"}
+    assert reg["_other"] == ("_c",)
+    K()  # decorator must not affect construction
