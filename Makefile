@@ -2,14 +2,15 @@
 
 PY ?= python
 
-.PHONY: install lint test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
 
 # static analysis (STATIC_ANALYSIS.md): ruff and mypy run when installed
 # (the hermetic CI image ships neither — their defect classes are covered
-# natively by mpclint MPL6xx); mpclint always runs and is the gate.
+# natively by mpclint MPL6xx); mpclint + mpcflow always run and are the
+# gate — check_all parses the AST once and feeds both analyzers.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null || command -v ruff >/dev/null 2>&1; then \
 	  echo "== ruff"; ruff check mpcium_tpu/ scripts/ tests/ || exit $$?; \
@@ -17,7 +18,12 @@ lint:
 	@if $(PY) -c "import mypy" 2>/dev/null; then \
 	  echo "== mypy"; $(PY) -m mypy mpcium_tpu/wire.py mpcium_tpu/config.py mpcium_tpu/utils/ || exit $$?; \
 	else echo "== mypy not installed — skipped"; fi
-	@echo "== mpclint"; $(PY) scripts/mpclint.py
+	@echo "== mpclint + mpcflow"; $(PY) scripts/check_all.py
+
+# the one-pass static gate alone (mpclint + mpcflow + budget drift,
+# shared AST parse) — what CI calls between edit and test
+check:
+	$(PY) scripts/check_all.py
 
 # smoke tier (< ~1 min target on a laptop core; full crypto suites are slow-marked)
 test:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
 
@@ -32,17 +32,31 @@ class Baseline:
     path: Path
     entries: Dict[str, str] = field(default_factory=dict)  # fp -> justification
 
-    def split(self, findings: Sequence[Finding]):
+    def split(
+        self,
+        findings: Sequence[Finding],
+        scope: Optional[Tuple[str, ...]] = None,
+    ):
         """Partition a sweep against this baseline.
 
         Returns ``(new, grandfathered, stale)`` where ``new`` are
         findings with no baseline entry, ``grandfathered`` are matched
         findings, and ``stale`` are baseline fingerprints that matched
-        nothing (each one must be deleted from the file)."""
+        nothing (each one must be deleted from the file).
+
+        The baseline is shared between mpclint (MPL) and mpcflow (MPF);
+        a runner that only executed one analyzer passes ``scope`` (rule
+        prefixes it actually ran) so the other family's entries aren't
+        reported stale. The combined gate (scripts/check_all.py) passes
+        no scope and enforces staleness over everything."""
         fps = {f.fingerprint for f in findings}
         new = [f for f in findings if f.fingerprint not in self.entries]
         grandfathered = [f for f in findings if f.fingerprint in self.entries]
-        stale = sorted(fp for fp in self.entries if fp not in fps)
+        stale = sorted(
+            fp
+            for fp in self.entries
+            if fp not in fps and (scope is None or fp.startswith(scope))
+        )
         return new, grandfathered, stale
 
     def save(self) -> None:

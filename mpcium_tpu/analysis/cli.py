@@ -95,7 +95,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except BaselineError as e:
             out.write(f"BASELINE ERROR: {e}\n")
             return 2
-        new, grandfathered, stale = baseline.split(result.findings)
+        # standalone mpclint runs only the MPL rules — MPF staleness is
+        # scripts/check_all.py's business (it runs both analyzers)
+        new, grandfathered, stale = baseline.split(
+            result.findings, scope=("MPL",)
+        )
 
     if not args.quiet:
         for f in new:

@@ -26,6 +26,12 @@ _DISABLE_FILE_RE = re.compile(
 )
 _SECRET_ANNOT_RE = re.compile(r"#\s*mpclint:\s*secret\b")
 _HOLDS_RE = re.compile(r"#\s*mpclint:\s*holds=([A-Za-z0-9_]+)")
+# mpcflow (analysis/flow/) annotations, indexed here so both tools share
+# one parse of every file:
+#   x = drain()       # mpcflow: host-ok — wire egress: payload leaves device
+#   pub = digest(sk)  # mpcflow: declassified — commitment, not the secret
+_HOST_OK_RE = re.compile(r"#\s*mpcflow:\s*host-ok(?:\s*[—-]\s*(.*))?$")
+_DECLASSIFY_RE = re.compile(r"#\s*mpcflow:\s*declassified\b")
 
 
 @dataclass(frozen=True)
@@ -66,6 +72,10 @@ class ParsedFile:
         self.secret_lines: Set[int] = set()
         # lines whose `def` carries `# mpclint: holds=<lock>`
         self.holds: Dict[int, str] = {}
+        # mpcflow: line -> reason for an intentional host transfer, and
+        # lines whose assignments declassify secret taint
+        self.host_ok: Dict[int, str] = {}
+        self.declassified: Set[int] = set()
         for i, text in enumerate(self.lines, start=1):
             m = _DISABLE_RE.search(text)
             if m:
@@ -82,6 +92,11 @@ class ParsedFile:
             m = _HOLDS_RE.search(text)
             if m:
                 self.holds[i] = m.group(1)
+            m = _HOST_OK_RE.search(text)
+            if m:
+                self.host_ok[i] = (m.group(1) or "").strip()
+            if _DECLASSIFY_RE.search(text):
+                self.declassified.add(i)
         # extra secret names declared via `# mpclint: secret` annotations:
         # every assignment/arg defined on an annotated line
         self.extra_secrets: Set[str] = set()
@@ -186,21 +201,33 @@ def iter_py_files(paths: Sequence[Path], root: Path) -> Iterator[Tuple[Path, str
             yield c, rel
 
 
-def lint_paths(
+def parse_project(
     paths: Sequence[Path],
-    rules: Sequence[Rule],
     root: Optional[Path] = None,
-) -> LintResult:
-    """Parse every ``.py`` under ``paths`` and run ``rules`` over them.
-    Suppressed findings are filtered here, centrally."""
+) -> Tuple[List[ParsedFile], List[str]]:
+    """Parse every ``.py`` under ``paths`` once → (files, parse_errors).
+    This is the shared AST cache: scripts/check_all.py parses here and
+    hands the same ParsedFile list to mpclint AND mpcflow."""
     root = root or Path.cwd()
-    result = LintResult()
     files: List[ParsedFile] = []
+    errors: List[str] = []
     for path, rel in iter_py_files(paths, root):
         try:
             files.append(ParsedFile(path, rel, path.read_text()))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
-            result.parse_errors.append(f"{rel}: {e}")
+            errors.append(f"{rel}: {e}")
+    return files, errors
+
+
+def lint_parsed(
+    files: Sequence[ParsedFile],
+    rules: Sequence[Rule],
+    parse_errors: Sequence[str] = (),
+) -> LintResult:
+    """Run ``rules`` over already-parsed files (see parse_project).
+    Suppressed findings are filtered here, centrally."""
+    result = LintResult()
+    result.parse_errors = list(parse_errors)
     result.files_scanned = len(files)
     ctx = LintContext(files)
     for pf in files:
@@ -217,6 +244,16 @@ def lint_paths(
                 result.findings.append(f)
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
     return result
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    rules: Sequence[Rule],
+    root: Optional[Path] = None,
+) -> LintResult:
+    """Parse + lint in one call (the single-tool entry point)."""
+    files, errors = parse_project(paths, root=root)
+    return lint_parsed(files, rules, parse_errors=errors)
 
 
 def run_lint(

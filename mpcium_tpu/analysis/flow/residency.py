@@ -1,0 +1,399 @@
+"""MPF8xx: device-residency analysis + the host-transfer budget.
+
+A function is **device-hot** when it is reachable (over the project call
+graph) from a protocol-phase entry point — the orchestration methods
+that drive jitted kernels (``OTMtALeg.run_multi``,
+``BatchedCoSigners.sign``, ``BatchedECDSASigningParty.receive``, …).
+Inside device-hot functions, every *host materialization* of a
+device-tracked value is a site:
+
+  - ``jax.device_get(x)`` and ``x.block_until_ready()`` — always;
+  - ``x.item()`` — always (a device scalar pulled to Python);
+  - ``np.asarray(x)`` / ``np.array(x)`` / ``x.tolist()`` /
+    ``bool(x)`` / ``int(x)`` / ``float(x)`` — when ``x`` is
+    device-tracked (bound from a ``jnp.*`` call, a jitted project
+    function, a ``jnp.ndarray``-annotated param/return, or the ``*_d``
+    naming convention).
+
+A site annotated ``# mpcflow: host-ok — reason`` is *intentional*: it
+raises no finding but is counted in the budget with its reason, so wire
+boundaries stay visible without blocking CI. Unannotated sites raise
+MPF801 (fix, annotate, or baseline with a justification naming the
+ROADMAP item that deletes it).
+
+``build_budget`` emits the per-phase machine-readable budget that
+``scripts/mpcflow_budget.py`` writes to ``HOST_TRANSFER_BUDGET.json``
+and the tier-1 gate diffs against the committed copy: ROADMAP item 2's
+"host touches only wire bytes" is this file monotonically shrinking.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding
+from .callgraph import CallGraph
+from .symbols import FuncInfo, FuncNode, ProjectIndex, _dotted
+
+RULE = "MPF801"
+
+# phase -> orchestration entry fids (order matters: a function reachable
+# from several phases is budgeted under the first one that claims it)
+PHASE_ENTRY_POINTS: Dict[str, Tuple[str, ...]] = {
+    "ecdsa.mta_ot": (
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.__init__",
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.run_multi",
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.run",
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.alice_round1",
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.bob_round2_multi",
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py::OTMtALeg.alice_round3_multi",
+    ),
+    "ecdsa.sign": (
+        "mpcium_tpu/engine/gg18_batch.py::GG18BatchCoSigners.sign",
+        # parties are constructed once per batch: __init__ is hot too
+        "mpcium_tpu/protocol/ecdsa/batch_signing.py::"
+        "BatchedECDSASigningParty.__init__",
+        "mpcium_tpu/protocol/ecdsa/batch_signing.py::"
+        "BatchedECDSASigningParty.start",
+        "mpcium_tpu/protocol/ecdsa/batch_signing.py::"
+        "BatchedECDSASigningParty.receive",
+    ),
+    "eddsa.sign": (
+        "mpcium_tpu/engine/eddsa_batch.py::BatchedCoSigners.sign",
+        "mpcium_tpu/engine/sharded.py::sharded_sign",
+    ),
+    "dkg": (
+        "mpcium_tpu/engine/dkg_batch.py::BatchedDKG.run",
+        "mpcium_tpu/engine/dkg_batch.py::BatchedReshare.run",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedDKGParty.__init__",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedDKGParty.start",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedDKGParty.receive",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedReshareParty.__init__",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedReshareParty.start",
+        "mpcium_tpu/protocol/batch_dkg.py::BatchedReshareParty.receive",
+    ),
+    "keygen.dealer": (
+        "mpcium_tpu/engine/eddsa_batch.py::dealer_keygen_batch",
+        "mpcium_tpu/engine/gg18_batch.py::dealer_keygen_secp_batch",
+    ),
+}
+
+# only code in these trees can be device-hot; serialization helpers in
+# wire.py / node/ that a phase reaches operate on host values by design
+_HOT_SCOPES = (
+    "mpcium_tpu/engine/",
+    "mpcium_tpu/ops/",
+    "mpcium_tpu/protocol/",
+)
+
+_DEVICE_CALL_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "lax.")
+_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "onp.asarray", "onp.array"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_SCALARIZERS = {"bool", "int", "float"}
+
+
+class Site:
+    __slots__ = ("phase", "path", "symbol", "line", "kind", "detail",
+                 "intentional", "reason")
+
+    def __init__(self, phase, path, symbol, line, kind, detail,
+                 intentional, reason):
+        self.phase = phase
+        self.path = path
+        self.symbol = symbol
+        self.line = line
+        self.kind = kind
+        self.detail = detail
+        self.intentional = intentional
+        self.reason = reason
+
+    def budget_row(self) -> Dict[str, object]:
+        row: Dict[str, object] = {
+            "path": self.path,
+            "symbol": self.symbol,
+            "kind": self.kind,
+            "detail": self.detail,
+            "intentional": self.intentional,
+        }
+        if self.reason:
+            row["reason"] = self.reason
+        return row
+
+
+def _annotation_is_device(ann) -> bool:
+    """True when the annotation mentions a device array type anywhere —
+    covers plain ``jnp.ndarray``, ``Tuple[jnp.ndarray, ...]``, and the
+    string form."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return "jnp.ndarray" in ann.value or "jax.Array" in ann.value
+    for node in ast.walk(ann):
+        d = _dotted(node)
+        if d in ("jnp.ndarray", "jax.Array"):
+            return True
+    return False
+
+
+def device_fn_names(index: ProjectIndex) -> Set[str]:
+    """Project function names that *consistently* return device values
+    (jitted or ``jnp.ndarray``-annotated everywhere the name is defined).
+
+    Covers calls the graph can't resolve because the callee module is a
+    runtime value — ``mod, _ = _curve(key_type); mod.decompress(...)``:
+    ``decompress`` is device-returning in both curve modules, so the
+    unresolved call is still tracked. Names defined with conflicting
+    device-ness anywhere in the project are excluded."""
+    seen: Dict[str, Optional[bool]] = {}
+    for fi in index.functions.values():
+        name = fi.qualname.rsplit(".", 1)[-1]
+        is_dev = fi.is_jit or _annotation_is_device(fi.node.returns)
+        if name in seen and seen[name] != is_dev:
+            seen[name] = None
+        else:
+            seen[name] = is_dev
+    return {n for n, v in seen.items() if v}
+
+
+class _DeviceTracker:
+    """Order-insensitive local device-value inference for one function."""
+
+    def __init__(self, fi: FuncInfo, index: ProjectIndex, graph: CallGraph,
+                 dev_names: Optional[Set[str]] = None):
+        self.fi = fi
+        self.index = index
+        self.graph = graph
+        self.dev_names = dev_names if dev_names is not None else set()
+        self.names: Set[str] = set()
+        a = fi.node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if _annotation_is_device(p.annotation) or p.arg.endswith("_d"):
+                self.names.add(p.arg)
+        # fixpoint over assignments (bodies are small; 2-3 passes settle)
+        assigns = [
+            n for n in ast.walk(fi.node)
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+        ]
+        for _ in range(4):
+            changed = False
+            for st in assigns:
+                value = getattr(st, "value", None)
+                if value is None or not self.is_device(value):
+                    continue
+                targets = (
+                    st.targets if isinstance(st, ast.Assign) else [st.target]
+                )
+                for t in targets:
+                    for leaf in self._target_names(t):
+                        if leaf not in self.names:
+                            self.names.add(leaf)
+                            changed = True
+            if not changed:
+                break
+
+    def _target_names(self, t):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from self._target_names(e)
+        elif isinstance(t, ast.Starred):
+            yield from self._target_names(t.value)
+        elif isinstance(t, ast.Attribute):
+            d = _dotted(t)
+            if d:
+                yield d
+
+    def is_device(self, e) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.names or e.id.endswith("_d")
+        if isinstance(e, ast.Attribute):
+            d = _dotted(e)
+            if d and (d in self.names or d.endswith("_d")):
+                return True
+            return self.is_device(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.is_device(e.value)
+        if isinstance(e, ast.Call):
+            dotted = _dotted(e.func)
+            if dotted.startswith(_DEVICE_CALL_PREFIXES):
+                return True
+            if dotted in _DEVICE_GET or dotted in _MATERIALIZERS:
+                return False  # result is a host value
+            fid = self.graph.resolve_callee(self.fi, e.func)
+            if fid is not None:
+                callee = self.index.functions.get(fid)
+                if callee is not None and (
+                    callee.is_jit
+                    or _annotation_is_device(callee.node.returns)
+                ):
+                    return True
+            elif (
+                isinstance(e.func, ast.Attribute)
+                and e.func.attr in self.dev_names
+            ):
+                return True
+            # method call on a device value keeps device-ness (.reshape…)
+            if isinstance(e.func, ast.Attribute) and e.func.attr not in (
+                "item", "tolist", "block_until_ready"
+            ):
+                return self.is_device(e.func.value)
+            return False
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self.is_device(x) for x in e.elts)
+        if isinstance(e, ast.BinOp):
+            return self.is_device(e.left) or self.is_device(e.right)
+        if isinstance(e, ast.IfExp):
+            return self.is_device(e.body) or self.is_device(e.orelse)
+        if isinstance(e, (ast.Await, ast.Starred)):
+            return self.is_device(e.value)
+        return False
+
+
+def classify_hot(index: ProjectIndex, graph: CallGraph) -> Dict[str, str]:
+    """fid -> phase for every device-hot function (first phase wins)."""
+    hot: Dict[str, str] = {}
+    for phase, entries in PHASE_ENTRY_POINTS.items():
+        roots = {e for e in entries if e in index.functions}
+        for fid in graph.reachable_from(roots):
+            fi = index.functions[fid]
+            if not fi.pf.rel.startswith(_HOT_SCOPES):
+                continue
+            hot.setdefault(fid, phase)
+    return hot
+
+
+def _arg_detail(e) -> str:
+    d = _dotted(e)
+    if d:
+        return d
+    if isinstance(e, ast.Call):
+        return _dotted(e.func) or type(e).__name__
+    if isinstance(e, ast.Subscript):
+        return _arg_detail(e.value) + "[]"
+    return type(e).__name__
+
+
+def scan_function(
+    fi: FuncInfo, phase: str, index: ProjectIndex, graph: CallGraph,
+    dev_names: Optional[Set[str]] = None,
+) -> List[Site]:
+    tracker = _DeviceTracker(fi, index, graph, dev_names)
+    nested: Set[int] = set()
+    for n in ast.walk(fi.node):
+        if isinstance(n, FuncNode) and n is not fi.node:
+            for sub in ast.walk(n):
+                nested.add(id(sub))
+    sites: List[Site] = []
+
+    def add(node, kind: str, detail: str) -> None:
+        line = node.lineno
+        reason = fi.pf.host_ok.get(line)
+        if reason is None:
+            reason = fi.pf.host_ok.get(line - 1)  # comment-above style
+        intentional = reason is not None
+        sites.append(
+            Site(phase, fi.pf.rel, fi.qualname, line, kind, detail,
+                 intentional, reason or "")
+        )
+
+    for node in ast.walk(fi.node):
+        if id(node) in nested or not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted in _DEVICE_GET:
+            add(node, "device_get",
+                _arg_detail(node.args[0]) if node.args else "?")
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "block_until_ready":
+                add(node, "block_until_ready", _arg_detail(node.func.value))
+            elif attr == "item" and not node.args:
+                add(node, "item", _arg_detail(node.func.value))
+            elif attr == "tolist" and tracker.is_device(node.func.value):
+                add(node, "tolist", _arg_detail(node.func.value))
+        if dotted in _MATERIALIZERS and node.args and tracker.is_device(
+            node.args[0]
+        ):
+            add(node, "np.asarray", _arg_detail(node.args[0]))
+        elif (
+            dotted in _SCALARIZERS
+            and node.args
+            and tracker.is_device(node.args[0])
+        ):
+            add(node, f"{dotted}()", _arg_detail(node.args[0]))
+    return sites
+
+
+def run_residency(
+    index: ProjectIndex, graph: CallGraph
+) -> Tuple[List[Finding], List[Site]]:
+    hot = classify_hot(index, graph)
+    dev_names = device_fn_names(index)
+    all_sites: List[Site] = []
+    findings: List[Finding] = []
+    for fid, phase in sorted(hot.items()):
+        fi = index.functions[fid]
+        for site in scan_function(fi, phase, index, graph, dev_names):
+            all_sites.append(site)
+            if site.intentional:
+                continue
+            if fi.pf.is_suppressed(RULE, site.line):
+                continue
+            f = Finding(
+                rule=RULE,
+                path=site.path,
+                line=site.line,
+                symbol=site.symbol,
+                key=f"{site.kind}:{site.detail}",
+                message=(
+                    f"host materialization ({site.kind} of {site.detail}) "
+                    f"on device-hot path [phase {phase}] — fix, annotate "
+                    f"'# mpcflow: host-ok — reason', or baseline against "
+                    f"a ROADMAP item"
+                ),
+            )
+            findings.append(f)
+    # dedupe by fingerprint (same kind+detail can appear twice in a body)
+    uniq: Dict[str, Finding] = {}
+    for f in findings:
+        uniq.setdefault(f.fingerprint, f)
+    return (
+        sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule, f.key)),
+        all_sites,
+    )
+
+
+def build_budget(sites: Sequence[Site]) -> Dict[str, object]:
+    """The machine-readable host-transfer budget (line-number free so the
+    committed JSON survives unrelated edits)."""
+    phases: Dict[str, Dict[str, object]] = {}
+    seen: Set[Tuple[str, str, str, str, str]] = set()
+    for s in sorted(
+        sites, key=lambda s: (s.phase, s.path, s.symbol, s.kind, s.detail)
+    ):
+        k = (s.phase, s.path, s.symbol, s.kind, s.detail)
+        if k in seen:
+            continue
+        seen.add(k)
+        ph = phases.setdefault(
+            s.phase,
+            {"total_sites": 0, "intentional": 0, "tracked": 0, "sites": []},
+        )
+        ph["total_sites"] += 1  # type: ignore[operator]
+        if s.intentional:
+            ph["intentional"] += 1  # type: ignore[operator]
+        else:
+            ph["tracked"] += 1  # type: ignore[operator]
+        ph["sites"].append(s.budget_row())  # type: ignore[union-attr]
+    return {
+        "comment": (
+            "Host-transfer budget per protocol phase (mpcflow MPF801). "
+            "'intentional' sites carry a '# mpcflow: host-ok' reason "
+            "(wire boundaries); 'tracked' sites are baselined debt tied "
+            "to ROADMAP items and must monotonically shrink. Regenerate "
+            "with scripts/mpcflow_budget.py."
+        ),
+        "phases": phases,
+    }

@@ -85,6 +85,9 @@ class _MethodScan(ast.NodeVisitor):
         self.nested: List[Tuple[str, str, int]] = []
         # lock -> same-class methods called while it is held
         self.calls_under: List[Tuple[str, str, int]] = []
+        # every same-class call: (method, locks_held_at_site, lineno) —
+        # MPL301 uses this for the one-level delegation exemption
+        self.self_calls: List[Tuple[str, Set[str], int]] = []
 
     def visit_With(self, node: ast.With) -> None:
         acquired: List[str] = []
@@ -143,6 +146,7 @@ class _MethodScan(ast.NodeVisitor):
             # self.other_method() while holding a lock → call edge
             f = self_attr(func)
             if f:
+                self.self_calls.append((f, set(self.held), node.lineno))
                 for h in self.held:
                     self.calls_under.append((h, f, node.lineno))
         self.generic_visit(node)
@@ -173,10 +177,10 @@ class UnguardedLockedField(Rule):
             field_to_lock: Dict[str, str] = {
                 f: lock for lock, fields in decls.items() for f in fields
             }
+            methods: Dict[str, ast.AST] = {}
+            scans: Dict[str, _MethodScan] = {}
             for meth in cls.body:
                 if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    continue
-                if meth.name == "__init__":
                     continue
                 held0: Set[str] = set()
                 holds = pf.holds.get(meth.lineno)
@@ -185,9 +189,35 @@ class UnguardedLockedField(Rule):
                 scan = _MethodScan(lock_names, held0)
                 for stmt in meth.body:
                     scan.visit(stmt)
-                for fieldname, lineno, held in scan.writes:
+                methods[meth.name] = meth
+                scans[meth.name] = scan
+            # method -> (caller, locks held at each same-class call site)
+            call_sites: Dict[str, List[Tuple[str, Set[str]]]] = {}
+            for caller, scan in scans.items():
+                for callee, held_at, _line in scan.self_calls:
+                    call_sites.setdefault(callee, []).append((caller, held_at))
+            for name, meth in methods.items():
+                if name == "__init__":
+                    continue
+                for fieldname, lineno, held in scans[name].writes:
                     lock = field_to_lock.get(fieldname)
                     if lock is None or lock in held:
+                        continue
+                    # one-level delegation: a private helper whose every
+                    # same-class call site already holds the lock is
+                    # effectively '# mpclint: holds=<lock>' — the lexical
+                    # held-set at the call site is what counts, so the
+                    # exemption does not chain through a second helper
+                    sites = [
+                        h
+                        for caller, h in call_sites.get(name, ())
+                        if caller != name
+                    ]
+                    if (
+                        name.startswith("_")
+                        and sites
+                        and all(lock in h for h in sites)
+                    ):
                         continue
                     yield Finding(
                         rule=self.id,

@@ -155,15 +155,19 @@ class EventConsumer:
                 reps, lambda: self._resume_keygen(wallet_id, reps)
             )
         for rep in others:
-            if rep.meta.get("kind") == "sign":
+            # the kind tag is routing metadata, not key material — but it
+            # rides inside the decrypted WAL record, so declassify the one
+            # field we log instead of formatting the record itself
+            kind = rep.meta.get("kind")  # mpcflow: declassified — WAL routing tag
+            if kind == "sign":
                 n += self._try_resume([rep], lambda r=rep: self._resume_sign(r))
-            elif rep.meta.get("kind") == "reshare":
+            elif kind == "reshare":
                 n += self._try_resume(
                     [rep], lambda r=rep: self._resume_reshare(r)
                 )
             else:
                 log.warn("unknown WAL kind — dropping",
-                         session=rep.session_id, kind=rep.meta.get("kind"))
+                         session=rep.session_id, kind=kind)
                 wal.drop(rep.session_id)
         if n:
             log.info("crash recovery: sessions resumed", node=self.node.node_id,

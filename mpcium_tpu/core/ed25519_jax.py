@@ -171,7 +171,7 @@ def scalar_ring() -> bn.BarrettCtx:
     return bn.BarrettCtx(hm.ED_L, PROF)
 
 
-def decompress(b: jnp.ndarray):
+def decompress(b: jnp.ndarray) -> "Tuple[EdPointJ, jnp.ndarray]":
     """Batch RFC 8032 decode: (..., 32) uint8 → (EdPointJ, ok mask).
 
     Invalid encodings (y ≥ p, non-residue x², x=0 with sign=1) yield the

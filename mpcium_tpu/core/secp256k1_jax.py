@@ -235,7 +235,7 @@ def _sqrt_ctx():
     return Secp256k1Sqrt()
 
 
-def decompress(b: jnp.ndarray):
+def decompress(b: jnp.ndarray) -> "Tuple[SecpPointJ, jnp.ndarray]":
     """Batch SEC1 decompression: (..., 33) uint8 → (SecpPointJ, ok mask).
 
     Bad encodings (wrong tag, x ≥ p, non-residue) yield ok=False with an

@@ -53,6 +53,7 @@ def _curve(key_type: str):
 
 def _compress_host(key_type: str, pt) -> List[bytes]:
     mod, _ = _curve(key_type)
+    # mpcflow: host-ok — public-point wire serialization (compressed bytes)
     return [bytes(c) for c in np.asarray(mod.compress(pt))]
 
 
@@ -189,12 +190,13 @@ class BatchedDKG:
             raise RuntimeError("batched DKG: VSS verification failed")
         # aggregate
         ring = mod.scalar_ring()
-        agg_shares = []
-        for j in range(q):
-            sj = subshares[0, j]
-            for i in range(1, q):
-                sj = ring.addmod(sj, subshares[i, j])
-            agg_shares.append(np.asarray(sj))
+        agg = subshares[0]
+        for i in range(1, q):
+            agg = ring.addmod(agg, subshares[i])
+        # single device→host pull for the whole (q, B) share block instead
+        # of one np.asarray round-trip per party
+        agg_host = np.asarray(agg)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
+        agg_shares = [agg_host[j] for j in range(q)]
         agg_pts = []
         for kdeg in range(t + 1):
             acc = pts[0][kdeg]
@@ -296,12 +298,12 @@ class BatchedReshare:
         if not bool(np.asarray(ok).all()):
             raise RuntimeError("batched resharing: VSS verification failed")
 
-        agg_shares = []
-        for j in range(len(self.new_committee)):
-            sj = subshares[0, j]
-            for i in range(1, q_old):
-                sj = ring.addmod(sj, subshares[i, j])
-            agg_shares.append(np.asarray(sj))
+        agg = subshares[0]
+        for i in range(1, q_old):
+            agg = ring.addmod(agg, subshares[i])
+        # single device→host pull, mirroring BatchedDKG.run
+        agg_host = np.asarray(agg)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
+        agg_shares = [agg_host[j] for j in range(len(self.new_committee))]
         agg_comp = []
         for kdeg in range(t_new + 1):
             acc = pts[0][kdeg]

@@ -342,7 +342,10 @@ class BatchedCoSigners:
         # -- local verification before publishing (reference
         # eddsa_signing_session.go:147) --------------------------------------
         ok = verify_signatures(sigs, jnp.asarray(self.A_comp), c64)
-        return np.asarray(sigs), np.asarray(ok & ok_R)
+        return (
+            np.asarray(sigs),  # mpcflow: host-ok — signature egress: final (R,s) leave device for callers
+            np.asarray(ok & ok_R),  # mpcflow: host-ok — per-wallet verification verdicts, egress with the signatures
+        )
 
 
 def dealer_keygen_batch(

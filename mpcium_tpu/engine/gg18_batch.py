@@ -433,7 +433,7 @@ class MtaBatch:
         rho_l = _bits_pack(rho_bits, _prof7(RHO_BITS))
         tot = A.pmx.ctx_N.reduce(_fold_add(mm.mul_pair(rho_l, s1_modN)))
         lhs = n2.mulmod(A.pmx.enc_deterministic(tot), SN)
-        if bool(np.asarray(_eq_all(lhs, Rp))[0]):
+        if bool(np.asarray(_eq_all(lhs, Rp))[0]):  # mpcflow: host-ok — single aggregated proof verdict gates the strict fallback
             return jnp.ones((B,), bool)
         log.warn("batched Alice-proof check failed — strict re-verification")
         return self._alice_enc_leg_strict(c_a, T, P, e_bits, s1_modN)
@@ -569,7 +569,7 @@ class MtaBatch:
             Sp = n2.prod_over_batch(n2.powmod(s_lift, rho_bits))[None]
             Rp = n2.prod_over_batch(n2.powmod(rhs, rho_bits))[None]
             SN = _host_pow_single(Sp, A.N, n2)
-            if bool(np.asarray(_eq_all(n2.mulmod(Mp, SN), Rp))[0]):
+            if bool(np.asarray(_eq_all(n2.mulmod(Mp, SN), Rp))[0]):  # mpcflow: host-ok — single aggregated proof verdict gates the strict fallback
                 return ok
             log.warn("batched Bob-proof check failed — strict re-verification")
         lhs = n2.mulmod(M, _host_pow_batch(s_lift, A.N, n2))
@@ -1047,7 +1047,7 @@ class GG18BatchCoSigners:
         def _mark(name, *tensors):
             if phase_times is not None:
                 for t in tensors:
-                    jax.block_until_ready(t)
+                    jax.block_until_ready(t)  # mpcflow: host-ok — bench instrumentation, only when phase_times is requested
                 now = _time.perf_counter()
                 phase_times[name] = now - _mark.last
                 _mark.last = now
@@ -1309,10 +1309,10 @@ class GG18BatchCoSigners:
         _mark("r5_phase5_combine_verify", ok, s)
 
         return {
-            "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),
-            "s": np.asarray(bn.limbs_to_bytes_le(s, P256, 32))[:, ::-1].copy(),
-            "recovery": np.asarray(rec),
-            "ok": np.asarray(ok),
+            "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
+            "s": np.asarray(bn.limbs_to_bytes_le(s, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
+            "recovery": np.asarray(rec),  # mpcflow: host-ok — signature egress
+            "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
         }
 
 

@@ -59,6 +59,7 @@ def _write_private_file(path, data: bytes) -> None:
     p = Path(path)
     fd = os.open(str(p), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     try:
+        # mpclint: disable=MPF703 — this IS the at-rest identity key store: 0600 file, scrypt+AEAD-wrapped when a passphrase is set
         os.write(fd, data)
     finally:
         os.close(fd)

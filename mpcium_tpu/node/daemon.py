@@ -89,7 +89,7 @@ def run_node(
         if isinstance(fault_plan, (str, Path)):
             fault_plan = FaultPlan.from_json(Path(fault_plan).read_text())
         transport = FaultyTransport(transport, name, fault_plan)
-        # mpclint: disable=MPL101 — fault-plan seed is the chaos replay handle and must be logged; not key material
+        # mpclint: disable=MPL101,MPF701 — fault-plan seed is the chaos replay handle and must be logged; not key material
         log.warn("CHAOS: fault plan installed", node=name,
                  seed=fault_plan.seed, rules=fault_plan.describe())
     if cfg.control_plane == "broker":

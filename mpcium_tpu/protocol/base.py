@@ -157,7 +157,14 @@ def snap_decode(v: Any) -> Any:
             if name not in _SNAP_TYPES:
                 raise TypeError(f"snapshot references unknown type {name!r}")
             return _SNAP_TYPES[name][2](payload)
-    raise TypeError(f"snapshot cannot decode {v!r}")
+    # report structure only: snapshot values are decrypted WAL state and
+    # may hold share material — repr() of the value must never reach an
+    # exception message (handlers log str(e))
+    tags = sorted(v) if isinstance(v, dict) else ()
+    raise TypeError(
+        f"snapshot cannot decode value of type {type(v).__name__}"
+        f" (tags: {list(tags)})"
+    )
 
 
 def party_xs(party_ids: Sequence[str]) -> Dict[str, int]:

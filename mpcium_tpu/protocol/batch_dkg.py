@@ -108,9 +108,9 @@ class _DealingMixin(BatchBlockMixin):
     B: int
 
     def _ser_scalars(self, x: jnp.ndarray) -> str:
-        return np.asarray(
-            bn.limbs_to_bytes_le(x, P256, 32)
-        ).tobytes().hex()
+        # mpcflow: host-ok — wire serialization of a scalar block
+        host = np.asarray(bn.limbs_to_bytes_le(x, P256, 32))
+        return host.tobytes().hex()
 
     def _parse_scalars(self, hexstr: str, order: int, pid: str) -> jnp.ndarray:
         arr = self._parse_block(hexstr, 32, pid)
@@ -125,11 +125,14 @@ class _DealingMixin(BatchBlockMixin):
         mod, _ = _curve(self.key_type)
         w = _comp_width(self.key_type)
         pts = []
+        ok_all = None
         for k in range(tp1):
             pt, ok = mod.decompress(jnp.asarray(block[:, k * w:(k + 1) * w]))
-            if not bool(np.asarray(ok).all()):
-                raise ProtocolError("bad commitment point in batch", pid)
+            ok_all = ok if ok_all is None else ok_all & ok
             pts.append(pt)
+        # one device→host sync for the whole block, not one per coefficient
+        if not bool(np.asarray(ok_all).all()):  # mpcflow: host-ok — verification verdict gates the protocol on host
+            raise ProtocolError("bad commitment point in batch", pid)
         return pts
 
     def _verify_dealer(
@@ -149,14 +152,14 @@ class _DealingMixin(BatchBlockMixin):
         ok = _blk_commit_check(
             self._bind_row(pid), blind, jnp.asarray(block_np), commit
         )
-        if not bool(np.asarray(ok).all()):
+        if not bool(np.asarray(ok).all()):  # mpcflow: host-ok — per-dealer verification verdict must gate the protocol on host
             raise ProtocolError("dealing decommitment mismatch", pid)
         pts = self._decompress_dealer_points(block_np, tp1, pid)
         pts_desc = tuple(pts[::-1])
         okv = _blk_vss_check(
             subshare, pts_desc, _xj_bits(self_x, self.B), self.key_type
         )
-        if not bool(np.asarray(okv).all()):
+        if not bool(np.asarray(okv).all()):  # mpcflow: host-ok — per-dealer verification verdict must gate the protocol on host
             raise ProtocolError("Feldman VSS share verification failed", pid)
         return pts
 
@@ -213,7 +216,8 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
             self.key_type,
         )
         self._block = block
-        payload = {"commit": np.asarray(commit).tobytes().hex()}
+        commit_host = np.asarray(commit)  # mpcflow: host-ok — commitment block leaves device for wire serialization
+        payload = {"commit": commit_host.tobytes().hex()}
         if self.key_type == "secp256k1":
             pre = self.pre
             pq = (pre.P - 1) // 2 * ((pre.Q - 1) // 2)
@@ -330,9 +334,10 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
                 agg_pts[k] = mod.add(agg_pts[k], pts[k])
 
         agg_comp = [
-            np.asarray(mod.compress(pt)) for pt in agg_pts
+            np.asarray(mod.compress(pt))  # mpcflow: host-ok — public VSS commitments, egress into the share objects
+            for pt in agg_pts
         ]  # (t+1) arrays of (B, w)
-        share_ints = bn.batch_from_limbs(np.asarray(agg_share), P256)
+        share_ints = bn.batch_from_limbs(np.asarray(agg_share), P256)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
         aux: Dict = {}
         if self.key_type == "secp256k1":
             pre = self.pre
@@ -458,10 +463,10 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
             self._coeffs, self._blind, self._bind_row(self.self_id),
             self.key_type,
         )
+        commit_host = np.asarray(commit)  # mpcflow: host-ok — commitment block leaves device for wire serialization
+        commit_hex = commit_host.tobytes().hex()  # mpcflow: declassified — hash commitment, protocol-public
         return [
-            self.broadcast(
-                RS_R1, {"commit": np.asarray(commit).tobytes().hex()}
-            )
+            self.broadcast(RS_R1, {"commit": commit_hex})
         ]
 
     def receive(self, msg: RoundMsg) -> List[RoundMsg]:
@@ -569,13 +574,14 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
                 for k in range(self.tp1):
                     agg_pts[k] = mod.add(agg_pts[k], pts[k])
         # binding: Σ_i C_i0 must equal the old public keys (batch)
-        pub_comp = np.asarray(mod.compress(agg_pts[0]))
+        pub_comp = np.asarray(mod.compress(agg_pts[0]))  # mpcflow: host-ok — public-key binding check against host-held old pubs
         for w in range(self.B):
             if bytes(pub_comp[w].tobytes()) != self.old_pubs[w]:
                 raise ProtocolError(
                     f"resharing changed the public key for wallet {w}"
                 )
         self._agg_share = agg_share
+        # mpcflow: host-ok — public VSS commitments, egress into the share objects
         self._agg_comp = [np.asarray(mod.compress(pt)) for pt in agg_pts]
 
     def _finalize(self) -> None:

@@ -106,7 +106,7 @@ def _nb(prof: bn.LimbProfile) -> int:
 
 
 def _ser(x: jnp.ndarray, prof: bn.LimbProfile) -> str:
-    return np.asarray(bn.limbs_to_bytes_le(x, prof, _nb(prof))).tobytes().hex()
+    return np.asarray(bn.limbs_to_bytes_le(x, prof, _nb(prof))).tobytes().hex()  # mpcflow: host-ok — wire serialization
 
 
 def _ser_bytes(arr) -> str:
@@ -220,7 +220,7 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
             ]).transpose(1, 0, 2)  # (t+1, B, 33)
         )
         self.W_pts: Dict[str, sp.SecpPointJ] = {}
-        self._ok = jnp.asarray(np.asarray(okY))
+        self._ok = okY
         for pid in self.party_ids:
             lam_bits = jnp.asarray(
                 sp.scalars_to_bits([self._lam[pid]])[0]
@@ -643,9 +643,9 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
         ok_f, s, rec = gb._blk_final(s, self.m, self._r, self.Y, self._rec)
         ok = self._ok & ok_f
         self.result = {
-            "r": np.asarray(sp.pack_be_32(self._r)),
-            "s": np.asarray(sp.pack_be_32(s)),
-            "recovery": np.asarray(rec),
-            "ok": np.asarray(ok),
+            "r": np.asarray(sp.pack_be_32(self._r)),  # mpcflow: host-ok — signature egress
+            "s": np.asarray(sp.pack_be_32(s)),  # mpcflow: host-ok — signature egress
+            "recovery": np.asarray(rec),  # mpcflow: host-ok — signature egress
+            "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
         }
         self.done = True

@@ -234,8 +234,8 @@ def base_ot_receive(
     R_comp, XS_comp = _k_base_receive(
         bits, jnp.asarray(delta), _bcast_pt(S_bytes, KAPPA)
     )
-    msgs = [bytes(r) for r in np.asarray(R_comp)]
-    keys = _pt_hash_rows(np.asarray(XS_comp))
+    msgs = [bytes(r) for r in np.asarray(R_comp)]  # mpcflow: host-ok — base-OT wire messages (κ=128 rows, once per pair)
+    keys = _pt_hash_rows(np.asarray(XS_comp))  # mpcflow: host-ok — ROT key derivation hashes on host (κ=128 rows, once per pair)
     return delta, keys, msgs
 
 
@@ -254,8 +254,8 @@ def base_ot_sender_keys(
     yR_comp, yRmS_comp = _k_base_sender(
         y_bits, R, _bcast_pt(hm.secp_compress(yS_neg), KAPPA)
     )
-    k0 = _pt_hash_rows(np.asarray(yR_comp))
-    k1 = _pt_hash_rows(np.asarray(yRmS_comp))
+    k0 = _pt_hash_rows(np.asarray(yR_comp))  # mpcflow: host-ok — ROT key derivation hashes on host (κ=128 rows, once per pair)
+    k1 = _pt_hash_rows(np.asarray(yRmS_comp))  # mpcflow: host-ok — ROT key derivation hashes on host (κ=128 rows, once per pair)
     return k0, k1
 
 
@@ -443,7 +443,7 @@ class OTMtALeg:
         local state kept for round 3."""
         B = a.shape[0]
         M = B * NBITS
-        r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)
+        r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)  # mpcflow: host-ok — choice bits feed the host-side OT extension (ROADMAP: IKNP on device)
         tag = self._ext_tag(ctr)
         t0, U = self._ext_alice_chunk(tag, _pack(r_bits), 0, B)
         self._alice_state = (t0, r_bits, B, tag)
@@ -533,8 +533,8 @@ class OTMtALeg:
                 self.rng.token_bytes(M * 32), np.uint8
             ).reshape(B, NBITS, 32)
             z_red = _reduce_bytes(jnp.asarray(z_raw))  # (B, NBITS, n)
-            m1 = np.asarray(_m1_payloads(z_red, _pow2_ladder(b_scalars)))
-            m0 = np.asarray(bn.limbs_to_bytes_le(z_red, P256, 32))
+            m1 = np.asarray(_m1_payloads(z_red, _pow2_ladder(b_scalars)))  # mpcflow: host-ok — OT payloads are pad-masked on host before the wire (ROADMAP: IKNP on device)
+            m0 = np.asarray(bn.limbs_to_bytes_le(z_red, P256, 32))  # mpcflow: host-ok — OT payloads are pad-masked on host before the wire (ROADMAP: IKNP on device)
             # mask INTO the pad buffers (ours, writable, dead after)
             y0 = native.xor_rows(pad0, m0.reshape(M, 32))
             y1 = native.xor_rows(pad1, m1.reshape(M, 32))

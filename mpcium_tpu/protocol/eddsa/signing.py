@@ -145,7 +145,7 @@ class EDDSASigningParty(PartyBase):
         lam = hm.lagrange_coeff(
             list(self.sign_xs.values()), self.self_x, hm.ED_L
         )
-        self._s_i = (self._r + c * lam * self.share.share) % hm.ED_L
+        self._s_i = (self._r + c * lam * self.share.share) % hm.ED_L  # mpcflow: declassified — partial response sᵢ is the R3 broadcast
         self._c = c
         return self.broadcast(R3, {"s": str(self._s_i)})
 

@@ -1,4 +1,4 @@
-"""Runtime-side mpclint annotations (zero-cost at runtime).
+"""Runtime-side mpclint/mpcflow annotations (zero-cost at runtime).
 
 ``@locked_by(lock, *fields)`` declares which instance attributes a class
 guards under which lock. mpclint's lock-discipline rule (MPL301) reads
@@ -18,9 +18,29 @@ See STATIC_ANALYSIS.md for the full registry.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple, TypeVar
+from typing import Callable, Dict, Generic, Tuple, TypeVar
 
 T = TypeVar("T", bound=type)
+_V = TypeVar("_V")
+
+
+class Secret(Generic[_V]):
+    """Type-annotation marker: the annotated value IS secret material,
+    whatever its spelling. mpcflow (analysis/flow/taint.py) reads it
+    statically — a parameter or return annotated ``Secret[...]`` seeds
+    the MPF7xx taint lattice at every call boundary::
+
+        def load_share(self, ...) -> "Secret[KeygenShare]": ...
+        def seal(self, plaintext: "Secret[bytes]") -> bytes: ...
+
+    At runtime it is inert: ``Secret[bytes]`` is just ``bytes`` to every
+    type checker via the alias below, and nothing is instantiated. Use
+    string-form annotations (as above) so importing modules stay free of
+    typing machinery at import time.
+    """
+
+    def __class_getitem__(cls, item):
+        return item
 
 # thread-name prefixes the tests' conftest leak-checker treats as
 # process-lifetime singletons; MPL502 accepts threads named under them

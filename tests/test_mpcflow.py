@@ -1,0 +1,117 @@
+"""Tier-1 mpcflow gate: both dataflow analyses over the whole package.
+
+This is ``make check``'s mpcflow stage as a test: any non-baselined
+taint/residency finding fails, any stale baseline entry fails, the
+committed HOST_TRANSFER_BUDGET.json must match the sweep exactly, and
+the sweep must stay fast enough to live in tier-1. The budget's two
+known host walls (the IKNP OT-extension host stage and the Ed25519 host
+SHA-512 round-trip) are asserted as *tracked* debt — if an edit makes
+them intentional or removes them, this test forces the bookkeeping
+(baseline + ROADMAP) to move in the same commit.
+"""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu.analysis import load_baseline
+from mpcium_tpu.analysis.baseline import DEFAULT_BASELINE
+from mpcium_tpu.analysis.flow import build_budget, run_flow
+
+pytestmark = pytest.mark.lint
+
+ROOT = Path(__file__).resolve().parents[1]
+BUDGET_PATH = ROOT / "HOST_TRANSFER_BUDGET.json"
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    t0 = time.monotonic()
+    result, sites = run_flow(root=ROOT)
+    elapsed = time.monotonic() - t0
+    return result, sites, elapsed
+
+
+def test_package_parses_clean(sweep):
+    result, _sites, _elapsed = sweep
+    assert not result.parse_errors, result.parse_errors
+    assert result.files_scanned > 60
+
+
+def test_no_new_findings_no_stale_entries(sweep):
+    result, _sites, _elapsed = sweep
+    baseline = load_baseline(ROOT / DEFAULT_BASELINE)
+    # MPF scope: stale MPL entries are test_mpclint's business
+    new, _grandfathered, stale = baseline.split(
+        result.findings, scope=("MPF",)
+    )
+    assert not new, "non-baselined dataflow findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, (
+        "stale mpcflow baseline entries (the baseline only shrinks):\n"
+        + "\n".join(stale)
+    )
+
+
+def test_sweep_is_tier1_fast(sweep):
+    _result, _sites, elapsed = sweep
+    # ~5s on the CI box for both analyses; 30s keeps it honest under load
+    assert elapsed < 30, f"mpcflow sweep took {elapsed:.1f}s"
+
+
+def test_budget_matches_committed_json(sweep):
+    _result, sites, _elapsed = sweep
+    assert BUDGET_PATH.exists(), (
+        "HOST_TRANSFER_BUDGET.json missing — run scripts/mpcflow_budget.py"
+    )
+    committed = json.loads(BUDGET_PATH.read_text())
+    assert committed == build_budget(sites), (
+        "HOST_TRANSFER_BUDGET.json drifted from the sweep — regenerate "
+        "with scripts/mpcflow_budget.py and review the diff"
+    )
+
+
+def _tracked(budget, phase):
+    return {
+        (s["path"], s["symbol"], s["detail"])
+        for s in budget["phases"][phase]["sites"]
+        if not s["intentional"]
+    }
+
+
+def test_budget_tracks_the_known_host_walls():
+    budget = json.loads(BUDGET_PATH.read_text())
+    # wall 1: IKNP OT-extension host stage (ROADMAP item 2 deletes it)
+    mta = _tracked(budget, "ecdsa.mta_ot")
+    assert (
+        "mpcium_tpu/protocol/ecdsa/mta_ot.py",
+        "OTMtALeg.run_multi",
+        "_bits_256",
+    ) in mta
+    # wall 2: Ed25519 host SHA-512 round-trip (device SHA-512 deletes it)
+    eddsa = _tracked(budget, "eddsa.sign")
+    assert {d for (_p, _s, d) in eddsa} >= {"R_comp", "R_sum"}
+
+
+def test_tracked_debt_is_baselined_with_an_exit():
+    """Every tracked budget site corresponds to a baseline entry whose
+    justification names its exit (wire boundary or ROADMAP item)."""
+    budget = json.loads(BUDGET_PATH.read_text())
+    baseline = load_baseline(ROOT / DEFAULT_BASELINE)
+    mpf = {
+        fp: j for fp, j in baseline.entries.items() if fp.startswith("MPF8")
+    }
+    for phase, ph in budget["phases"].items():
+        for s in ph["sites"]:
+            if s["intentional"]:
+                continue
+            fp = (
+                f"MPF801:{s['path']}:{s['symbol']}:"
+                f"{s['kind']}:{s['detail']}"
+            )
+            assert fp in mpf, f"tracked site not baselined: {fp} ({phase})"
+            assert "wire boundary" in mpf[fp] or "ROADMAP" in mpf[fp], fp

@@ -225,6 +225,73 @@ def test_locked_field_catches_container_mutation():
     assert rule_ids(lint(bad, [UnguardedLockedField()])) == ["MPL301"]
 
 
+def test_locked_field_one_level_delegation():
+    """Regression: an unmarked private helper whose every same-class call
+    site holds the declared lock is effectively ``holds=_lock`` — no
+    false positive, and no ``# mpclint: holds=`` marker required."""
+    ok = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def start(self):
+            with self._lock:
+                self._flip()
+        def restart(self):
+            with self._lock:
+                self._flip()
+        def _flip(self):
+            self._started = True
+    """
+    assert lint(ok, [UnguardedLockedField()]) == []
+    # one call site does NOT hold the lock → still a finding
+    bad = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def start(self):
+            with self._lock:
+                self._flip()
+        def hot_path(self):
+            self._flip()
+        def _flip(self):
+            self._started = True
+    """
+    found = lint(bad, [UnguardedLockedField()])
+    assert rule_ids(found) == ["MPL301"]
+    assert found[0].key == "_started"
+    # the exemption does not chain: a helper reached only through a
+    # second unmarked helper keeps its finding (one level only)
+    two_deep = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def start(self):
+            with self._lock:
+                self._mid()
+        def _mid(self):
+            self._flip()
+        def _flip(self):
+            self._started = True
+    """
+    assert rule_ids(lint(two_deep, [UnguardedLockedField()])) == ["MPL301"]
+    # a public (no leading underscore) method never gets the exemption
+    public = """
+    from mpcium_tpu.utils.annotations import locked_by
+
+    @locked_by("_lock", "_started")
+    class Session:
+        def start(self):
+            with self._lock:
+                self.flip()
+        def flip(self):
+            self._started = True
+    """
+    assert rule_ids(lint(public, [UnguardedLockedField()])) == ["MPL301"]
+
+
 def test_lock_order_inversion_cycle():
     bad = """
     class S:
