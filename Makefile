@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install lint check test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check trace-check test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -21,9 +21,18 @@ lint:
 	@echo "== mpclint + mpcflow"; $(PY) scripts/check_all.py
 
 # the one-pass static gate alone (mpclint + mpcflow + budget drift,
-# shared AST parse) — what CI calls between edit and test
+# shared AST parse) — what CI calls between edit and test; the trace
+# gate rides along (--no-sweep: the sweep just ran)
 check:
 	$(PY) scripts/check_all.py
+	$(PY) scripts/trace_check.py --no-sweep
+
+# mpctrace gate alone (OBSERVABILITY.md): committed TRACE_sample.json
+# validates + covers every instrumented layer, and a traced protocol
+# run is transcript-identical to an untraced one; includes the static
+# sweep so it is self-contained. --regen rebuilds the sample.
+trace-check:
+	$(PY) scripts/trace_check.py
 
 # smoke tier (< ~1 min target on a laptop core; full crypto suites are slow-marked)
 test:

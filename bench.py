@@ -360,15 +360,25 @@ def main() -> None:
 
     # one phase-profiled run (sync at phase boundaries) — skipped on the
     # degraded CPU path, where a duplicate full run costs minutes and
-    # measures nothing the timed run doesn't
+    # measures nothing the timed run doesn't. Phase shares come from the
+    # tracing spans the engine emits (utils/tracing.PhaseTimer), folded
+    # back into the legacy table shape by phase_share().
     phases: dict = {}
     profiled_s = 0.0
     if platform == "tpu":
+        from mpcium_tpu.utils import tracing
+
         _STATE["stage"] = "profiled_run"
-        t0 = time.perf_counter()
-        out = signer.sign(digests, phase_times=phases)
-        profiled_s = time.perf_counter() - t0
+        spans: list = []
+        tracing.enable(sink=spans.append)
+        try:
+            t0 = time.perf_counter()
+            out = signer.sign(digests)
+            profiled_s = time.perf_counter() - t0
+        finally:
+            tracing.disable()
         assert out["ok"].all()
+        phases = tracing.phase_share(spans)
 
     # timed runs (no internal sync)
     _STATE["stage"] = "timed_run"
@@ -463,9 +473,16 @@ def main() -> None:
             # pipeline's overlap ratio (fraction of host time hidden
             # behind device compute) — the chunked double-buffer's win,
             # measured rather than asserted.
-            phases_ot: dict = {}
-            out = signer_ot.sign(digests, phase_times=phases_ot)
+            from mpcium_tpu.utils import tracing
+
+            spans_ot: list = []
+            tracing.enable(sink=spans_ot.append)
+            try:
+                out = signer_ot.sign(digests)
+            finally:
+                tracing.disable()
             assert out["ok"].all()
+            phases_ot = tracing.phase_share(spans_ot)
             record["gg18_ot_mta_phase_s"] = {
                 k: round(v, 3) for k, v in phases_ot.items()
             }

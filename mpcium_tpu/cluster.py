@@ -24,6 +24,8 @@ from .node.node import Node
 from .registry.registry import PeerRegistry
 from .store.keyinfo import KeyinfoStore
 from .store.kvstore import EncryptedFileKV, MemoryKV
+from .trace import arm as _trace_arm
+from .trace import snapshot_chrome as _trace_snapshot_chrome
 from .transport.loopback import LoopbackFabric
 from .utils import log
 
@@ -142,6 +144,11 @@ class LocalCluster(SyncOps):
 
         self.root = Path(root_dir or tempfile.mkdtemp(prefix="mpcium-tpu-"))
         self.node_ids = [f"node{i}" for i in range(n_nodes)]
+        # flight recorder is always on for clusters: bounded per-node ring
+        # buffers, merged on demand by trace_snapshot(); incident dumps land
+        # under the cluster root so drills can attach them to reports
+        _trace_arm(node_ids=self.node_ids,
+                   dump_dir=str(self.root / "trace_incidents"))
         # None overrides are skipped by init_config → config defaults apply
         init_config(path=str(self.root / "nonexistent.yaml"),
                     mpc_threshold=threshold,
@@ -294,6 +301,23 @@ class LocalCluster(SyncOps):
             nid: ec.metrics.snapshot()
             for nid, ec in self.node_consumers.items()
         }
+
+    def trace_snapshot(self, clear: bool = False,
+                       meta: Optional[dict] = None) -> dict:
+        """Merge every node's flight-recorder ring buffer (plus the shared
+        engine/client tracks) into one Chrome-trace-event JSON document —
+        pid = node, tid = session/lane — loadable in Perfetto / chrome://
+        tracing. Buffers survive :meth:`close`, so drills can snapshot
+        after teardown."""
+        return _trace_snapshot_chrome(clear=clear, meta=meta)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition for the whole cluster: each node's
+        registry rendered with a ``node`` label, concatenated."""
+        return "".join(
+            ec.metrics.to_prometheus(labels={"node": nid})
+            for nid, ec in self.node_consumers.items()
+        )
 
     def _wrap_faults(self, owner: str, transport):
         """Wrap ``transport`` in a FaultyTransport when a fault plan is

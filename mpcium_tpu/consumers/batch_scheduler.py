@@ -67,12 +67,16 @@ from ..node.session import Session
 from ..protocol.base import KeygenShare, ProtocolError
 from ..protocol.eddsa.batch_signing import BatchedEDDSASigningParty
 from ..transport.api import Transport
-from ..utils import log
+from ..utils import log, tracing
 from ..utils.annotations import locked_by
 from ..utils.metrics import MetricsRegistry
 
 _DIGEST_CACHE_CAP = 4096  # (key_type, wallet, epoch) -> material digest LRU
 _INTAKE_TS_CAP = 1 << 18  # e2e-latency bookkeeping bound (entries, not bytes)
+# late-duplicate absorption window after a sign batch settles: must
+# outlast the transport's redelivery backoff for a chaos-dropped intake
+_SETTLED_TTL_S = 30.0
+_SETTLED_CAP = 4096
 
 
 class _TimingWheel:
@@ -228,6 +232,7 @@ def _manifest_body(
     "_buckets",
     "_batch_claims",
     "_live_claims",
+    "_settled",
     "_sessions",
     "_decline_responders",
     "_digest_cache",
@@ -318,6 +323,15 @@ class BatchSigningScheduler:
         # (sign/reshare runners hand off to a Session and return; the
         # claims stay owned until that session's _prune)
         self._live_claims: Dict[str, set] = {}
+        # dedup string -> monotonic settle time, SIGN ONLY: a chaos-
+        # dropped intake can be redelivered seconds after the batch that
+        # answered it finished and forgot its claims, and buffering it
+        # then strands a lane entry until the fallback sweep. Sign
+        # retries always carry a FRESH tx id, so a same-dedup arrival
+        # inside the TTL is by construction a duplicate delivery, never
+        # a retry — absorb it. (kg/rs dedup keys are wallet-scoped and
+        # ARE reused by retries, so they never enter this map.)
+        self._settled: OrderedDict[str, float] = OrderedDict()
         # ONE timing-wheel thread serves every window, liveness fallback,
         # deadline sweep, and decline expiry — keys ("win"|"fb"|"dl", bucket)
         # and ("decl", session_id)
@@ -547,6 +561,16 @@ class BatchSigningScheduler:
                 # an orphaned lane entry (nonzero depth gauge) until a
                 # sweep collects it. Absorb it instead.
                 return True
+            settled_at = self._settled.get(d)
+            if settled_at is not None:
+                if time.monotonic() - settled_at < _SETTLED_TTL_S:
+                    # Later still: the covering batch already finished
+                    # and forgot its claims (a dropped delivery can be
+                    # redelivered after the whole batch settled). Sign
+                    # retries carry fresh tx ids, so this is a duplicate
+                    # of an ANSWERED request — absorb, don't strand.
+                    return True
+                del self._settled[d]
             self._buckets.setdefault(key, []).append(entry)
             self._note_depth(entry.lane, +1)
             ts_key = (entry.kind, ek[0], ek[1])
@@ -573,6 +597,13 @@ class BatchSigningScheduler:
             )
             if entry.deadline_at != float("inf"):
                 self._arm_deadline_locked(key, entry.deadline_at)
+        tracing.instant(
+            "intake", node=self.node.node_id, tid=f"lane:{entry.lane}",
+            req_kind=entry.kind, deadline_ms=(
+                0 if entry.deadline_at == float("inf")
+                else int((entry.deadline_at - entry.added_at) * 1000)
+            ),
+        )
         if fire_after:
             # continuous batching: drain every full chunk ready right now
             # (the remainder waits for the window or the next submit)
@@ -640,6 +671,19 @@ class BatchSigningScheduler:
         the consumer's bookkeeping (its own lock)."""
         self._m_shed.inc()
         (self._m_shed_bp if backpressure else self._m_shed_dl).inc()
+        # the queued lifetime of the refused entry as a lane span, plus a
+        # shed incident (which triggers a flight-recorder dump when a
+        # dump dir is configured) — an SLO miss is explainable from the
+        # trace alone: lane, age, reason, backpressure-vs-deadline
+        tracing.emit(
+            "queue", int(e.added_at * 1e9), tracing.now_ns(),
+            node=self.node.node_id, tid=f"lane:{e.lane}",
+            req_kind=e.kind, outcome="shed", backpressure=backpressure,
+        )
+        tracing.incident(
+            "shed", node=self.node.node_id, tid=f"lane:{e.lane}",
+            req_kind=e.kind, reason=reason, backpressure=backpressure,
+        )
         ek = _entry_key(e.kind, e.msg)
         self._observe_e2e(e.kind, ek)
         seq = next(self._shed_seq)
@@ -797,6 +841,7 @@ class BatchSigningScheduler:
         pop-and-forget."""
         while True:
             now = time.monotonic()
+            t_fire0 = tracing.now_ns()
             with self._lock:
                 self._wheel.cancel(("win", key))
                 unfired = [
@@ -829,6 +874,20 @@ class BatchSigningScheduler:
             }
             self.transport.pubsub.publish(
                 wire.TOPIC_BATCH_MANIFEST, json.dumps(manifest).encode()
+            )
+            # the dispatch decision + each entry's queued lifetime, on the
+            # lane track, linked to the downstream batch session by id
+            t_disp = tracing.now_ns()
+            for e in entries:
+                tracing.emit(
+                    "queue", int(e.added_at * 1e9), t_disp,
+                    node=self.node.node_id, tid=f"lane:{e.lane}",
+                    req_kind=kind, outcome="dispatched", batch=batch_id,
+                )
+            tracing.emit(
+                "dispatch", t_fire0, t_disp,
+                node=self.node.node_id, tid=f"lane:{entries[0].lane}",
+                req_kind=kind, batch=batch_id, n=len(entries),
             )
             if len(entries) < self.max_batch:
                 return  # bucket drained below a full chunk
@@ -1055,6 +1114,16 @@ class BatchSigningScheduler:
                 self._batch_claims[d] = self._batch_claims.get(d, 0) + 1
         return inherited
 
+    def _settle_locked(self, dedups) -> None:  # mpclint: holds=_lock
+        """Stamp settled SIGN dedup strings for the late-duplicate
+        absorption window (see _settled). Caller holds self._lock."""
+        now = time.monotonic()
+        for d in dedups:
+            self._settled[d] = now
+            self._settled.move_to_end(d)
+        while len(self._settled) > _SETTLED_CAP:
+            self._settled.popitem(last=False)
+
     def _forget_locked(self, kind: str, keys) -> None:  # mpclint: holds=_lock
         """Decrement (and drop at zero) the refcounts for ``keys``.
         Caller holds self._lock."""
@@ -1065,6 +1134,8 @@ class BatchSigningScheduler:
                 self._batch_claims[d] = n
             else:
                 self._batch_claims.pop(d, None)
+                if kind == "sign":
+                    self._settle_locked([d])
 
     def _forget_batch_claims(self, kind: str, inherited) -> None:
         """Batch thread is done (success, release, or crash): the
@@ -1641,7 +1712,9 @@ class BatchSigningScheduler:
             with self._lock:
                 if session in self._sessions:
                     self._sessions.remove(session)
-                self._live_claims.pop(f"bsign:{batch_id}", None)
+                owned_ds = self._live_claims.pop(f"bsign:{batch_id}", None)
+                if owned_ds:
+                    self._settle_locked(owned_ds)
             session.close()
 
         session = Session(

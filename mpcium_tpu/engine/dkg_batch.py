@@ -39,6 +39,13 @@ from ..core import secp256k1_jax as sp
 from ..core.bignum import P256
 from ..ops.sha256 import sha256 as dev_sha256
 from ..protocol.base import KeygenShare, party_xs
+from ..utils import tracing
+
+
+def _trace_sync(tensors) -> None:
+    """Phase-boundary sync for mpctrace phase timers — reached only when
+    tracing is armed (untraced runs never sync here)."""
+    jax.block_until_ready(tensors)  # mpcflow: host-ok — trace instrumentation, only when tracing is armed
 
 SCALAR_BITS = 256
 
@@ -173,6 +180,9 @@ class BatchedDKG:
         wallet-aligned. Raises on any VSS/commitment failure."""
         mod, order = _curve(self.key_type)
         q, t, B = len(self.ids), self.t, n_wallets
+        _pt = tracing.PhaseTimer(
+            "dkg.run", _trace_sync, node="engine", tid=f"dkg:B{B}",
+        )
         xs_tuple = tuple(self.xs[p] for p in self.ids)
         coeffs = jnp.asarray(
             _rand_scalars((q, t + 1, B), order, self.rng)
@@ -183,9 +193,12 @@ class BatchedDKG:
             ).reshape(q, B, 32)
         )
         pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
+        _pt.mark("commit", commits)
         # reveal phase is implicit in-process; re-check binding + VSS
         subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
+        _pt.mark("subshare", subshares)
         ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
+        _pt.mark("vss_verify", ok)
         if not bool(np.asarray(ok).all()):
             raise RuntimeError("batched DKG: VSS verification failed")
         # aggregate
@@ -225,6 +238,7 @@ class BatchedDKG:
                         threshold=t,
                     )
                 )
+        _pt.mark("aggregate_assemble")
         return out
 
 
@@ -261,6 +275,9 @@ class BatchedReshare:
         ring = mod.scalar_ring()
         B, t_new = self.B, self.t_new
         q_old = len(self.old_quorum)
+        _pt = tracing.PhaseTimer(
+            "reshare.run", _trace_sync, node="engine", tid=f"reshare:B{B}",
+        )
         new_xs = party_xs(self.new_committee)
         xs_tuple = tuple(new_xs[p] for p in self.new_committee)
         first = self.old_shares[0][0]
@@ -282,8 +299,11 @@ class BatchedReshare:
             ).reshape(q_old, B, 32)
         )
         pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
+        _pt.mark("commit", commits)
         subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
+        _pt.mark("subshare", subshares)
         ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
+        _pt.mark("vss_verify", ok)
 
         # redeal binding: Σ_i C_i0 must equal the old public key
         pub_sum = pts[0][0]
@@ -329,4 +349,5 @@ class BatchedReshare:
                         aux={"is_reshared": True},
                     )
                 )
+        _pt.mark("aggregate_assemble")
         return out

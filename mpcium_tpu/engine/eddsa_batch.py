@@ -33,6 +33,13 @@ from ..core import bignum as bn
 from ..core import ed25519_jax as ed
 from ..core import hostmath as hm
 from ..core.bignum import P256 as PROF
+from ..utils import tracing
+
+
+def _trace_sync(tensors) -> None:
+    """Phase-boundary sync for mpctrace phase timers — reached only when
+    tracing is armed (untraced runs never sync here)."""
+    jax.block_until_ready(tensors)  # mpcflow: host-ok — trace instrumentation, only when tracing is armed
 
 # 512-bit inputs (hash outputs / wide nonces) occupy 43 twelve-bit limbs —
 # within BarrettCtx.reduce's 2n = 44-limb bound.
@@ -295,9 +302,16 @@ class BatchedCoSigners:
 
     def sign(self, messages: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
         """Run the full 3-round protocol for B sessions → ((B, 64)
-        signatures, (B,) ok mask). Raises on commitment fraud."""
+        signatures, (B,) ok mask). Raises on commitment fraud.
+
+        With mpctrace armed, device-phase spans (``phase:*``) are emitted
+        with a sync at each phase boundary; untraced runs take the no-op
+        path — no syncs, bit-identical results."""
         assert len(messages) == self.B
         q, B = self.q, self.B
+        _pt = tracing.PhaseTimer(
+            "eddsa.sign", _trace_sync, node="engine", tid=f"eddsa:B{B}",
+        )
 
         # -- round 1: nonce commitments (one (q, B) dispatch) + batch
         # commitments (native C++ SHA-256: one call per party, not B) ------
@@ -317,6 +331,7 @@ class BatchedCoSigners:
             )
             for p in range(q)
         ]
+        _pt.mark("r1_nonce_commit")
 
         # -- round 2: decommit + verify (batch hash check, device aggregate)
         for p in range(q):
@@ -327,6 +342,7 @@ class BatchedCoSigners:
             if not (again == commits[p]).all():
                 raise RuntimeError("commitment fraud detected")
         R_sum, ok_R = aggregate_nonce(jnp.asarray(R_host))
+        _pt.mark("r2_decommit_aggregate", R_sum)
 
         # -- round 3: challenge (host hash) + partials (one (q, B) dispatch)
         c64 = jnp.asarray(
@@ -338,10 +354,12 @@ class BatchedCoSigners:
             jnp.asarray(self.lamx),
         )
         sigs, _ = combine_signatures(parts, R_sum)
+        _pt.mark("r3_challenge_partials_combine", sigs)
 
         # -- local verification before publishing (reference
         # eddsa_signing_session.go:147) --------------------------------------
         ok = verify_signatures(sigs, jnp.asarray(self.A_comp), c64)
+        _pt.mark("verify", ok)
         return (
             np.asarray(sigs),  # mpcflow: host-ok — signature egress: final (R,s) leave device for callers
             np.asarray(ok & ok_R),  # mpcflow: host-ok — per-wallet verification verdicts, egress with the signatures

@@ -56,7 +56,13 @@ from ..ops import modmul as mm
 from ..ops.paillier_mxu import RAND_BITS, PaillierMXUPrivate
 from ..ops.sha256 import sha256 as dev_sha256
 from ..protocol.base import KeygenShare, party_xs
-from ..utils import log
+from ..utils import log, tracing
+
+
+def _trace_sync(tensors) -> None:
+    """Phase-boundary sync for mpctrace/bench phase timers — reached only
+    when tracing is armed or a phase_times dict was requested."""
+    jax.block_until_ready(tensors)  # mpcflow: host-ok — trace/bench instrumentation, only when tracing or phase_times is requested
 
 Q = hm.SECP_N
 SCALAR_BITS = 256
@@ -1039,20 +1045,15 @@ class GG18BatchCoSigners:
         """``digests``: (B, 32) big-endian digests. Returns dict with
         r, s (B, 32 BE bytes), recovery (B,), ok mask (B,).
 
-        ``phase_times``: optional dict — when given, the engine blocks at
-        phase boundaries and records wall seconds per protocol phase
-        (bench diagnostics; adds sync overhead)."""
-        import time as _time
-
-        def _mark(name, *tensors):
-            if phase_times is not None:
-                for t in tensors:
-                    jax.block_until_ready(t)  # mpcflow: host-ok — bench instrumentation, only when phase_times is requested
-                now = _time.perf_counter()
-                phase_times[name] = now - _mark.last
-                _mark.last = now
-
-        _mark.last = _time.perf_counter()
+        ``phase_times``: optional dict — when given (or when mpctrace is
+        armed), the engine blocks at phase boundaries and records wall
+        seconds per protocol phase as ``phase:*`` spans plus the legacy
+        dict (bench diagnostics; adds sync overhead only then)."""
+        _pt = tracing.PhaseTimer(
+            "gg18.sign", _trace_sync, phase_times=phase_times,
+            node="engine", tid=f"gg18:B{self.B}",
+        )
+        _mark = _pt.mark
         B, q = self.B, self.q
         ring = self.ring
         m = ring.reduce(
@@ -1089,7 +1090,7 @@ class GG18BatchCoSigners:
             from ..protocol.ecdsa.mta_ot import resolve_chunks
 
             ot_chunks = resolve_chunks(B)
-            ot_timings = {} if phase_times is not None else None
+            ot_timings = {} if _pt.on else None
             for (a, b) in self.pairs:
                 leg = self.ot_legs[(a, b)]
                 # one extension serves BOTH products (same k_a choice
@@ -1101,24 +1102,25 @@ class GG18BatchCoSigners:
                 for name, (al, be) in zip(("gamma", "w"), shares):
                     alpha_shares[(a, b, name)] = al
                     beta_shares[(a, b, name)] = be
-            _mark("r2_mta_ot",
-                  *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs])
-            if phase_times is not None and ot_timings:
-                # host/device A/B split of the OT phase: host_s is
-                # worker-thread busy time, device is main-thread block
-                # time on device arrays; hidden host time (host_s minus
-                # the residual main-thread wait on the worker) over
-                # host_s is the pipeline's overlap ratio.
+            # host/device A/B split of the OT phase rides the span as
+            # attrs (and the legacy dict as r2_mta_ot_* keys): host_s is
+            # worker-thread busy time, device is main-thread block time
+            # on device arrays; hidden host time (host_s minus the
+            # residual main-thread wait on the worker) over host_s is
+            # the pipeline's overlap ratio.
+            ot_attrs = {}
+            if ot_timings:
                 host_s = ot_timings.get("host_s", 0.0)
                 hidden = max(0.0, host_s - ot_timings.get("host_wait_s", 0.0))
-                phase_times["r2_mta_ot_host"] = host_s
-                phase_times["r2_mta_ot_device"] = ot_timings.get(
-                    "device_wait_s", 0.0
-                )
-                phase_times["r2_mta_ot_overlap_ratio"] = (
-                    hidden / host_s if host_s > 0 else 0.0
-                )
-                phase_times["r2_mta_ot_chunks"] = float(ot_chunks)
+                ot_attrs = {
+                    "host": host_s,
+                    "device": ot_timings.get("device_wait_s", 0.0),
+                    "overlap_ratio": hidden / host_s if host_s > 0 else 0.0,
+                    "chunks": float(ot_chunks),
+                }
+            _mark("r2_mta_ot",
+                  *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs],
+                  **ot_attrs)
             return self._finish_sign(
                 _mark, m, ok, k, gamma, Gamma, Gamma_comp,
                 g_commit, g_blind, alpha_shares, beta_shares,

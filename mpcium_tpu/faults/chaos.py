@@ -43,7 +43,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import wire
 from ..cluster import LocalCluster, load_test_preparams
-from ..utils import log
+from ..trace import snapshot_chrome
+from ..utils import log, tracing
 from .plan import FaultPlan, named_plan
 from .transport import FaultStats
 
@@ -64,6 +65,9 @@ class DrillReport:
     error: str = ""
     # kill-resume: wall time from respawn to the resumed session's result
     resume_latency_s: float = 0.0
+    # merged cross-node Chrome-trace-event JSON (flight-recorder snapshot;
+    # load in Perfetto / chrome://tracing)
+    trace: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -78,6 +82,7 @@ class DrillReport:
             "notes": self.notes,
             "error": self.error,
             "resume_latency_s": round(self.resume_latency_s, 3),
+            "trace": self.trace,
         }
 
 
@@ -580,10 +585,20 @@ def run_drill(name: str, seed: int = DEFAULT_SEED,
     except Exception as e:  # noqa: BLE001 — report, don't crash the runner
         outcome, ok, notes, plan_json, faults = "error", False, [], {}, {}
         err = repr(e)
+    # flight-recorder buffers survive cluster close — merge every node's
+    # ring into one Perfetto-loadable document for the report; a failed
+    # drill also drops an incident dump (dir set by the drill's cluster,
+    # so it only survives when the operator keeps the root)
+    if not ok:
+        tracing.incident("drill-failure", node="local", drill=name,
+                         outcome=outcome)
+    trace_doc = snapshot_chrome(
+        clear=True, meta={"drill": name, "seed": seed, "outcome": outcome},
+    )
     return DrillReport(
         name=name, seed=seed, expected=expected, outcome=outcome, ok=ok,
         duration_s=time.monotonic() - t0, plan=plan_json, faults=faults,
-        notes=notes, error=err, **extra,
+        notes=notes, error=err, trace=trace_doc, **extra,
     )
 
 

@@ -22,9 +22,41 @@ from ..identity.identity import IdentityStore
 from ..registry.registry import PeerRegistry
 from ..store.keyinfo import KeyinfoStore
 from ..store.kvstore import EncryptedFileKV, FileKV
+from ..trace import arm as trace_arm
 from ..transport.tcp import tcp_transport
 from ..utils import log
 from .node import Node
+
+
+def publish_health(consumer, control_kv, name: str) -> dict:
+    """One health beat: publish the consumer's operational snapshot as
+    JSON under ``health/<name>`` and the same registry as Prometheus text
+    exposition under ``health/<name>.prom`` — so ``kv get health/node0``
+    stays the whole monitoring story and a scrape sidecar can serve
+    ``.prom`` verbatim. Returns the JSON snapshot (tests assert on it)."""
+    snap = consumer.health()
+    snap["ts"] = time.time()
+    control_kv.put(
+        f"health/{name}",
+        json.dumps(snap, sort_keys=True).encode(),
+    )
+    control_kv.put(
+        f"health/{name}.prom",
+        consumer.metrics.to_prometheus(labels={"node": name}).encode(),
+    )
+    return snap
+
+
+def health_loop(consumer, control_kv, name: str, stop: threading.Event,
+                interval_s: float = 10.0) -> None:
+    """Periodic health publisher (daemon thread body). A failed publish
+    is logged and the beat continues — monitoring must never kill the
+    node it monitors."""
+    while not stop.wait(interval_s):
+        try:
+            publish_health(consumer, control_kv, name)
+        except Exception as e:  # noqa: BLE001 — never kill the beat
+            log.warn("health publish failed", node=name, error=repr(e))
 
 
 def load_peers(cfg, kv=None) -> dict:
@@ -62,6 +94,10 @@ def run_node(
         level="DEBUG" if debug else "INFO",
     )
     check_required(cfg, ["badger_password", "event_initiator_pubkey"])
+    # arm the flight recorder for this node: bounded ring buffer, incident
+    # dumps (shed / timeout / drill failure) land under the db dir
+    trace_arm(node_ids=[name],
+              dump_dir=str(Path(cfg.db_dir) / name / "trace_incidents"))
     passphrase = cfg.passphrase or None
     if decrypt_private_key and passphrase is None:
         passphrase = getpass.getpass(f"passphrase for {name} identity key: ")
@@ -174,21 +210,9 @@ def run_node(
     # ``health/<name>`` — the same KV operators already watch for peer
     # liveness, so `kv get health/node0` is the whole monitoring story
     health_stop = threading.Event()
-
-    def _health_loop():
-        while not health_stop.wait(10.0):
-            try:
-                snap = consumer.health()
-                snap["ts"] = time.time()
-                control_kv.put(
-                    f"health/{name}",
-                    json.dumps(snap, sort_keys=True).encode(),
-                )
-            except Exception as e:  # noqa: BLE001 — never kill the beat
-                log.warn("health publish failed", node=name, error=repr(e))
-
     threading.Thread(
-        target=_health_loop, name=f"health-{name}", daemon=True
+        target=health_loop, args=(consumer, control_kv, name, health_stop),
+        name=f"health-{name}", daemon=True,
     ).start()
     log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")
 

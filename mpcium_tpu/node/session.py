@@ -23,7 +23,7 @@ from ..identity.identity import IdentityStore
 from ..protocol.base import PartyBase, ProtocolError, RoundMsg
 from ..store.session_wal import SessionWALWriter
 from ..transport.api import Transport, TransportError
-from ..utils import log
+from ..utils import log, tracing
 from ..utils.annotations import locked_by
 from ..wire import Envelope
 
@@ -137,6 +137,11 @@ class Session:
         self._sent_raw: List[tuple] = []
         self.created_at = time.monotonic()
         self.last_activity = self.created_at
+        # mpctrace: every node derives the SAME trace id from the public
+        # session id, so merged cross-node views group without any
+        # coordination; wire context only refines parent/child edges
+        self._trace_id = tracing.trace_id_for(session_id)
+        self._trace_t0 = tracing.now_ns()
         self._done_evt = threading.Event()
         # one-shot claim for _finish, distinct from _done_evt: close() sets
         # the event for waiters, which must not make a racing _finish skip
@@ -291,6 +296,10 @@ class Session:
         transport.pubsub.publish(broadcast_topic, env.encode())
 
     def _route(self, msgs: Sequence[RoundMsg]) -> None:
+        # outbound trace context: the ids of the round span this batch of
+        # messages came out of (None — and absent from the wire — when
+        # tracing is off, keeping envelope bytes identical to pre-trace)
+        ctx = tracing.wire_context()
         for m in msgs:
             env = Envelope(
                 session_id=m.session_id,
@@ -299,6 +308,7 @@ class Session:
                 payload=m.payload,
                 to=m.to,
                 is_broadcast=m.is_broadcast,
+                trace=ctx,
             )
             self.identity.sign_envelope(env)
             raw = env.encode()
@@ -479,12 +489,13 @@ class Session:
             payload=env.payload,
             to=env.to,
         )
+        parent = env.trace.get("s") if env.trace else None
         with self._lock:
             self.last_activity = time.monotonic()
             if not self._started:
                 self._buffer.append(msg)
                 return
-        self._deliver(msg)
+        self._deliver(msg, parent=parent)
 
     def _on_hello(self, from_id: str) -> None:
         start_now = False
@@ -515,30 +526,40 @@ class Session:
             # buffers while _started is False, so receive() cannot run
             # before start() has, and start() runs exactly once
             # (_start_claimed is a one-shot)
-            out = self.party.start()
-            with self._lock:
-                self._started = True
-                buffered, self._buffer = self._buffer, []
-                if self._wal is not None:
-                    # commit the start-time randomness (nonce commitments,
-                    # Shamir coefficients) before anything leaves the node
-                    self._checkpoint(out)
-            self._route(out)
+            with tracing.span(
+                "round:start", trace_id=self._trace_id,
+                node=self.node_id, tid=self.session_id,
+            ):
+                out = self.party.start()
+                with self._lock:
+                    self._started = True
+                    buffered, self._buffer = self._buffer, []
+                    if self._wal is not None:
+                        # commit the start-time randomness (nonce
+                        # commitments, Shamir coefficients) before
+                        # anything leaves the node
+                        self._checkpoint(out)
+                self._route(out)
             for m in buffered:
                 self._deliver(m)
         except Exception as e:  # noqa: BLE001
             self._fail(e)
 
-    def _deliver(self, msg: RoundMsg) -> None:
+    def _deliver(self, msg: RoundMsg, parent: Optional[str] = None) -> None:
         try:
-            with self._lock:
-                if self._failed or self.party.done:
-                    return
-                out = self.party.receive(msg)
-                finished = self.party.done
-                if self._wal is not None and (out or finished):
-                    self._checkpoint(out)
-            self._route(out)
+            with tracing.span(
+                f"round:{msg.round}", trace_id=self._trace_id,
+                parent_id=parent, node=self.node_id, tid=self.session_id,
+                sender=msg.from_id,
+            ):
+                with self._lock:
+                    if self._failed or self.party.done:
+                        return
+                    out = self.party.receive(msg)
+                    finished = self.party.done
+                    if self._wal is not None and (out or finished):
+                        self._checkpoint(out)
+                self._route(out)
             if finished:
                 self._finish()
         except ProtocolError as e:
@@ -551,6 +572,11 @@ class Session:
             if self._finished:
                 return
             self._finished = True
+        tracing.emit(
+            "session", self._trace_t0, tracing.now_ns(),
+            node=self.node_id, tid=self.session_id,
+            trace_id=self._trace_id, outcome="ok", resumed=self._resumed,
+        )
         log.info("session complete", session=self.session_id, node=self.node_id)
         if self.on_done:
             try:
@@ -581,6 +607,15 @@ class Session:
                     return
                 self._failed = True
         culprit = getattr(e, "culprit", None)
+        tracing.emit(
+            "session", self._trace_t0, tracing.now_ns(),
+            node=self.node_id, tid=self.session_id,
+            trace_id=self._trace_id, outcome="fail", error=type(e).__name__,
+        )
+        tracing.incident(
+            "session-fail", node=self.node_id, tid=self.session_id,
+            error=type(e).__name__, retryable=isinstance(e, RetryableSessionError),
+        )
         log.error("session failed", session=self.session_id, node=self.node_id,
                   error=str(e), culprit=culprit or "")
         # a failed session must not resurrect at the next boot; only a hard

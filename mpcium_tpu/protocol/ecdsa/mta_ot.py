@@ -71,6 +71,7 @@ from ...core import bignum as bn
 from ...core import hostmath as hm
 from ...core import secp256k1_jax as sp
 from ...core.bignum import P256
+from ...utils import tracing
 
 KAPPA = 128  # IKNP width / computational security parameter
 NBITS = 256  # multiplicand bits (secp256k1 scalars)
@@ -593,6 +594,7 @@ class OTMtALeg:
         tag = self._ext_tag(ctr)
         M = B * NBITS
         t_total0 = time.perf_counter()
+        t_span0 = tracing.now_ns()
 
         r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)
         r_packed = _pack(r_bits)
@@ -690,4 +692,13 @@ class OTMtALeg:
                 timings.get("total_s", 0.0)
                 + time.perf_counter() - t_total0
             )
+        # mpctrace: one span per extension with the overlap split as
+        # public attrs (no-op unless tracing is armed)
+        tracing.emit(
+            "phase:ot_extension", t_span0, tracing.now_ns(),
+            node="engine", tid=f"ot:B{B}",
+            host_wait_s=round(host_wait, 6),
+            device_wait_s=round(device_wait, 6),
+            chunks=K, sets=len(b_list),
+        )
         return list(zip(alphas, betas))

@@ -33,6 +33,7 @@ import numpy as np
 from ...core import bignum as bn
 from ...core import hostmath as hm
 from ...engine import eddsa_batch as eb
+from ...utils import tracing
 from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
 
 R1_COMMIT = "eddsa/bsign/1/commit"
@@ -99,9 +100,13 @@ class BatchedEDDSASigningParty(PartyBase):
         return f"{self.session_id}:{self.self_id}".encode()
 
     def start(self) -> List[RoundMsg]:
-        r64 = eb.fresh_nonce_bytes(self.B, self.rng)
-        self._r_limbs, R_comp = eb.nonce_commitments(eb.to_dev(r64))
-        self._R_block = np.asarray(R_comp).tobytes()  # B·32 bytes
+        # device-phase spans: each heavy round materializes its result to
+        # host bytes before the span closes, so the interval is honest
+        # device time; with tracing off these are the no-op singleton
+        with tracing.span("phase:bsign_nonce_commit", batch=self.B):
+            r64 = eb.fresh_nonce_bytes(self.B, self.rng)
+            self._r_limbs, R_comp = eb.nonce_commitments(eb.to_dev(r64))
+            self._R_block = np.asarray(R_comp).tobytes()  # B·32 bytes
         self._blind = self.rng.token_bytes(32)
         commit = _block_commit(self._blind, self._R_block, self._bind())
         self._stage = 1
@@ -159,16 +164,19 @@ class BatchedEDDSASigningParty(PartyBase):
         R_all = np.stack(
             [np.frombuffer(b, dtype=np.uint8).reshape(self.B, 32) for b in R_blocks]
         )
-        R_sum, ok_R = eb.aggregate_nonce(eb.to_dev(R_all, axis=1))
-        self._R_sum = np.asarray(R_sum)
-        self._ok_R = np.asarray(ok_R)
-        self._c64 = eb.challenge_hashes(self._R_sum, self.A_comp, self.messages)
-        parts = eb.partial_signature(
-            self._r_limbs, eb.to_dev(self._c64), eb.to_dev(self.lamx)
-        )
-        s_block = np.asarray(
-            bn.limbs_to_bytes_le(parts, bn.P256, 32)
-        )
+        with tracing.span("phase:bsign_aggregate_partial", batch=self.B):
+            R_sum, ok_R = eb.aggregate_nonce(eb.to_dev(R_all, axis=1))
+            self._R_sum = np.asarray(R_sum)
+            self._ok_R = np.asarray(ok_R)
+            self._c64 = eb.challenge_hashes(
+                self._R_sum, self.A_comp, self.messages
+            )
+            parts = eb.partial_signature(
+                self._r_limbs, eb.to_dev(self._c64), eb.to_dev(self.lamx)
+            )
+            s_block = np.asarray(
+                bn.limbs_to_bytes_le(parts, bn.P256, 32)
+            )
         self._parts = parts
         return self.broadcast(R3_PARTIAL, {"s": s_block.tobytes().hex()})
 
@@ -183,12 +191,13 @@ class BatchedEDDSASigningParty(PartyBase):
                 bn.bytes_to_limbs_le(jnp.asarray(arr), bn.P256, bn.P256.n_limbs)
             )
         parts = jnp.stack(stacked)
-        sigs, _s = eb.combine_signatures(parts, eb.to_dev(self._R_sum))
-        ok = eb.verify_signatures(
-            sigs, eb.to_dev(self.A_comp), eb.to_dev(self._c64)
-        )
-        self.result = {
-            "signatures": np.asarray(sigs),
-            "ok": np.asarray(ok) & self._ok_R,
-        }
+        with tracing.span("phase:bsign_combine_verify", batch=self.B):
+            sigs, _s = eb.combine_signatures(parts, eb.to_dev(self._R_sum))
+            ok = eb.verify_signatures(
+                sigs, eb.to_dev(self.A_comp), eb.to_dev(self._c64)
+            )
+            self.result = {
+                "signatures": np.asarray(sigs),
+                "ok": np.asarray(ok) & self._ok_R,
+            }
         self.done = True

@@ -10,17 +10,24 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, List
 
+from ..utils import tracing
 from .base import PartyBase
 
 
 def run_protocol(parties: Dict[str, PartyBase], max_msgs: int = 100_000) -> None:
     """Drive all parties until done. Raises on protocol errors/stalls."""
     queue: deque = deque()
+    # mpctrace: the in-process fabric plays "every node" — pid is the
+    # party id, one shared trace id for the whole run. Spans only exist
+    # when tracing is armed; message flow is identical either way.
+    run_tid = tracing.trace_id_for("runner:" + "|".join(sorted(parties)))
     # sorted: every member must walk the peer set identically (dict order
     # is insertion order, which differs per node) — MPL202
     for _pid, party in sorted(parties.items()):
-        for m in party.start():
-            queue.append(m)
+        with tracing.span("round:start", trace_id=run_tid, node=_pid,
+                          tid="runner"):
+            for m in party.start():
+                queue.append(m)
     delivered = 0
     while queue:
         msg = queue.popleft()
@@ -33,8 +40,11 @@ def run_protocol(parties: Dict[str, PartyBase], max_msgs: int = 100_000) -> None
             else [parties[msg.to]]
         )
         for t in targets:
-            for out in t.receive(msg):
-                queue.append(out)
+            with tracing.span(f"round:{msg.round}", trace_id=run_tid,
+                              node=t.self_id, tid="runner",
+                              sender=msg.from_id):
+                for out in t.receive(msg):
+                    queue.append(out)
     stalled = [pid for pid, p in sorted(parties.items()) if not p.done]
     if stalled:
         raise RuntimeError(f"protocol stalled; undone parties: {stalled}")

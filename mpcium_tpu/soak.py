@@ -455,6 +455,14 @@ class SoakRun:
             # reached EXACTLY ONE terminal outcome
             "accounting_ok": (pending == 0
                               and submitted == succeeded + shed + failed),
+            # cluster-wide Prometheus text exposition (also written as a
+            # .prom sidecar by scripts/load_soak.py) and the merged
+            # cross-node flight-recorder trace (Perfetto-loadable)
+            "prometheus": self.cluster.prometheus_text(),
+            "trace": self.cluster.trace_snapshot(
+                clear=True,
+                meta={"soak_seed": cfg.seed, "chaos": cfg.chaos or None},
+            ),
         }
         return report
 

@@ -9,10 +9,13 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import sys
 import time
 import traceback
 from typing import Any
+
+from . import tracing
 
 _logger = logging.getLogger("mpcium_tpu")
 _production = False
@@ -36,6 +39,12 @@ def init(production: bool | None = None, level: str = "INFO") -> None:
 def _emit(level: int, msg: str, kv: dict) -> None:
     if not _logger.handlers:
         init()
+    # log/trace correlation: when a span is open on this thread, every
+    # record carries its ids so a log line can be found in the trace
+    ids = tracing.current_ids()
+    if ids is not None:
+        kv.setdefault("trace_id", ids[0])
+        kv.setdefault("span_id", ids[1])
     if _production:
         record = {
             "level": logging.getLevelName(level).lower(),
@@ -51,11 +60,30 @@ def _emit(level: int, msg: str, kv: dict) -> None:
         )
 
 
+def _is_secret_name(name: str) -> bool:
+    # lazy import: taxonomy is stdlib-only, but keep log importable
+    # without dragging the analysis package in at interpreter start
+    from ..analysis.taxonomy import is_secret_name
+
+    # the taxonomy tokenizer splits snake_case; type names are CamelCase
+    # (NonceShare), so de-camel before asking
+    snake = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", name)
+    return is_secret_name(name) or is_secret_name(snake)
+
+
 def _safe(v: Any):
     if isinstance(v, bytes):
         return v.hex()
     if isinstance(v, (str, int, float, bool, type(None))):
         return v
+    # refuse to repr() objects that look like key material: a type or
+    # attribute name hitting the secret taxonomy means the default repr
+    # could serialize secrets into a log line (MPL101's runtime twin)
+    tname = type(v).__name__
+    attr_names = list(getattr(v, "__dict__", ()) or ())
+    attr_names += [a for a in getattr(type(v), "__slots__", ()) or ()]
+    if _is_secret_name(tname) or any(_is_secret_name(a) for a in attr_names):
+        return f"<redacted:{tname}>"
     return repr(v)
 
 

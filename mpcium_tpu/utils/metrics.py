@@ -110,19 +110,30 @@ class Histogram:
         with self._lock:
             return self.sum / self.count if self.count else None
 
+    @staticmethod
+    def _rank(ordered, q: float) -> Optional[float]:
+        if not ordered:
+            return None
+        rank = max(1, math.ceil(q / 100.0 * len(ordered)))
+        return ordered[rank - 1]
+
     def summary(self) -> Dict[str, Optional[float]]:
+        # one lock acquisition, one reservoir copy, ONE sort for all
+        # three quantiles (percentile() re-sorts per call — fine for a
+        # spot read, wasteful for every snapshot/health publish)
         with self._lock:
             count, total = self.count, self.sum
             lo, hi = self.min, self.max
+            ordered = sorted(self._samples)
         return {
             "count": count,
             "sum": total,
             "min": lo,
             "max": hi,
             "mean": (total / count) if count else None,
-            "p50": self.percentile(50),
-            "p90": self.percentile(90),
-            "p99": self.percentile(99),
+            "p50": self._rank(ordered, 50),
+            "p90": self._rank(ordered, 90),
+            "p99": self._rank(ordered, 99),
         }
 
 
@@ -180,3 +191,46 @@ class MetricsRegistry:
             else:
                 out["gauges"][name] = m.value  # type: ignore[union-attr]
         return out
+
+    def to_prometheus(self, labels: Optional[Dict[str, str]] = None) -> str:
+        """Prometheus text exposition (format 0.0.4): counters and
+        gauges as-is, histograms as summaries with ``quantile`` labels
+        plus ``_count``/``_sum``. Dots in names become underscores;
+        ``labels`` (e.g. ``{"node": "node0"}``) are applied to every
+        sample so per-node texts can be concatenated."""
+        base = dict(labels or {})
+        with self._lock:
+            items: Tuple[Tuple[str, object], ...] = tuple(self._metrics.items())
+
+        def fmt(name: str, value: float, extra: Optional[Dict[str, str]] = None) -> str:
+            lbl = {**base, **(extra or {})}
+            body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(lbl.items()))
+            return f"{name}{{{body}}} {value}" if body else f"{name} {value}"
+
+        lines = []
+        for name, m in sorted(items):
+            pname = _prom_name(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(fmt(pname, m.value))
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(fmt(pname, m.value))
+            elif isinstance(m, Histogram):
+                s = m.summary()
+                lines.append(f"# TYPE {pname} summary")
+                for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                    if s[key] is not None:
+                        lines.append(fmt(pname, s[key], {"quantile": q}))
+                lines.append(fmt(f"{pname}_count", s["count"] or 0))
+                lines.append(fmt(f"{pname}_sum", s["sum"] or 0.0))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    return out if not out[:1].isdigit() else f"_{out}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")

@@ -55,6 +55,12 @@ class Envelope:
     # never covered by signing bytes), so legacy signed envelopes stay
     # byte-identical; bump only with a parser that handles both.
     v: int = 0
+    # mpctrace context ({"t": trace_id, "s": span_id}): observability
+    # metadata, same omit-while-default contract as ``v`` — absent from
+    # JSON when None and NEVER covered by signing bytes, so legacy peers
+    # ignore it and traced envelopes verify against untraced signatures.
+    # Unauthenticated by design; must never feed a protocol decision.
+    trace: Optional[Dict[str, str]] = None
 
     def marshal_for_signing(self) -> bytes:
         return canonical_json(
@@ -80,6 +86,8 @@ class Envelope:
         }
         if self.v:
             out["v"] = self.v
+        if self.trace:
+            out["trace"] = self.trace
         return out
 
     @classmethod
@@ -93,6 +101,7 @@ class Envelope:
             is_broadcast=d.get("is_broadcast", True),
             signature=bytes.fromhex(d.get("signature", "")),
             v=int(d.get("v", 0)),
+            trace=d.get("trace"),
         )
 
     def encode(self) -> bytes:

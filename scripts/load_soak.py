@@ -113,10 +113,19 @@ def main() -> int:
         wait_timeout_s=args.timeout,
     )
     report = run_soak(cfg)
-    print(json.dumps(report, indent=2))
+    # keep stdout reviewable: the embedded trace document is for Perfetto,
+    # not eyeballs — elide it from the console copy only
+    console = dict(report)
+    trace = console.pop("trace", {})
+    console["trace_events"] = len(trace.get("traceEvents", []))
+    print(json.dumps(console, indent=2))
     if args.out:
         write_report(report, args.out)
-        print(f"report written to {args.out}", file=sys.stderr)
+        stem = os.path.splitext(args.out)[0]
+        with open(stem + ".prom", "w") as f:
+            f.write(report.get("prometheus", ""))
+        print(f"report written to {args.out} "
+              f"(+ {stem}.prom metrics sidecar)", file=sys.stderr)
     return 0 if report["accounting_ok"] else 1
 
 

@@ -99,6 +99,22 @@ def test_smoke_soak_sheds_retries_and_closes_the_books(tmp_path):
     # request, retried or not — all six have a number.
     assert report["latency_ms"]["overall"]["count"] == 6
 
+    # The report embeds a merged cross-node Chrome trace spanning every
+    # instrumented layer (the acceptance list of the tracing PR) plus
+    # the Prometheus text for all nodes.
+    from mpcium_tpu.trace import validate_chrome
+
+    trace = report["trace"]
+    assert validate_chrome(trace) > 0
+    names = {e["name"] for e in trace["traceEvents"] if e.get("ph") != "M"}
+    assert {"intake", "queue", "dispatch", "session"} <= names, sorted(names)
+    assert any(n.startswith("round:") for n in names), sorted(names)
+    assert any(n.startswith("phase:") for n in names), sorted(names)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert len(pids) >= 3  # one pid per node
+    assert "scheduler_batches_fired_total" in report["prometheus"]
+    assert 'node="node0"' in report["prometheus"]
+
     # Zero leaked threads: every worker the whole cluster+scheduler+chaos
     # stack started must be gone (or daemon/registered) once the soak
     # returns — the conftest leak fixture would catch this at session end,

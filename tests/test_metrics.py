@@ -84,3 +84,63 @@ def test_registry_reuses_and_type_checks():
     import json
 
     json.dumps(snap)
+
+
+def test_summary_single_sort_matches_percentile():
+    # summary() computes all three quantiles from ONE sorted copy; it
+    # must agree with the per-call percentile() path exactly
+    h = Histogram("h")
+    for v in [5, 1, 9, 3, 7, 2, 8, 4, 6, 10]:
+        h.observe(v)
+    s = h.summary()
+    assert s["p50"] == h.percentile(50)
+    assert s["p90"] == h.percentile(90)
+    assert s["p99"] == h.percentile(99)
+    assert s["min"] == 1 and s["max"] == 10
+
+
+def test_summary_empty_histogram():
+    s = Histogram("h").summary()
+    assert s["count"] == 0
+    assert s["p50"] is None and s["p90"] is None and s["p99"] is None
+    assert s["mean"] is None
+
+
+def test_to_prometheus_exposition_format():
+    r = MetricsRegistry()
+    r.counter("scheduler.shed_total").inc(7)
+    r.gauge("scheduler.queue_depth").set(3)
+    h = r.histogram("scheduler.latency_s")
+    for v in range(1, 101):
+        h.observe(v / 100.0)
+
+    text = r.to_prometheus(labels={"node": "node0"})
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    assert "# TYPE scheduler_shed_total counter" in lines
+    assert 'scheduler_shed_total{node="node0"} 7.0' in lines
+    assert "# TYPE scheduler_queue_depth gauge" in lines
+    assert 'scheduler_queue_depth{node="node0"} 3.0' in lines
+    assert "# TYPE scheduler_latency_s summary" in lines
+    assert 'scheduler_latency_s{node="node0",quantile="0.5"} 0.5' in lines
+    assert 'scheduler_latency_s_count{node="node0"} 100' in lines
+    assert 'scheduler_latency_s_sum{node="node0"} 50.5' in lines
+    # names are prometheus-safe: no dots survive
+    for ln in lines:
+        if not ln.startswith("#"):
+            assert "." not in ln.split("{")[0].split(" ")[0]
+
+
+def test_to_prometheus_no_labels_and_empty_registry():
+    r = MetricsRegistry()
+    assert r.to_prometheus() == ""
+    r.counter("a").inc()
+    text = r.to_prometheus()
+    assert "a 1.0" in text.splitlines()
+
+
+def test_to_prometheus_label_escaping():
+    r = MetricsRegistry()
+    r.counter("c").inc()
+    text = r.to_prometheus(labels={"node": 'we"ird\nname'})
+    assert 'node="we\\"ird\\nname"' in text

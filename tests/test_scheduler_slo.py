@@ -242,6 +242,44 @@ def test_backpressure_shed_is_loud_and_releases_claim(fabric):
         s.close()
 
 
+def test_settled_sign_duplicate_absorbed_not_stranded(fabric):
+    """A chaos-dropped sign intake redelivered AFTER its covering batch
+    settled (claims forgotten) must be absorbed, not buffered: sign
+    retries carry fresh tx ids, so a same-dedup arrival inside the TTL
+    is a duplicate of an answered request — buffering it would strand a
+    lane entry (nonzero depth gauge) until the fallback sweep."""
+    s = _sched(fabric, window_s=60.0, max_queue_depth=10)
+    try:
+        leader = "n1"
+        msg = _tx("w", "t1")
+        d = s._dedup_str("sign", _entry_key("sign", msg))
+        # batch lifecycle in miniature: claim registered, then settled
+        with s._lock:
+            s._batch_claims[d] = 1
+            s._forget_locked("sign", [_entry_key("sign", msg)])
+        assert d in s._settled
+        # the late redelivery is handled (True) but NOT buffered
+        assert s._buffer_entry(
+            KEY, s._mk_entry(msg, "reply.t1", "sign"), leader
+        )
+        depth = s.metrics.gauge(
+            f"scheduler.queue_depth.{wire.PRIORITY_BULK}"
+        ).value
+        assert depth == 0, "late duplicate stranded a lane entry"
+        # past the TTL the same dedup buffers normally again
+        with s._lock:
+            s._settled[d] = time.monotonic() - (bs._SETTLED_TTL_S + 1)
+        assert s._buffer_entry(
+            KEY, s._mk_entry(msg, "reply.t1", "sign"), leader
+        )
+        assert s.metrics.gauge(
+            f"scheduler.queue_depth.{wire.PRIORITY_BULK}"
+        ).value == 1
+        assert d not in s._settled  # expired stamp pruned on read
+    finally:
+        s.close()
+
+
 # -- deadline sheds --------------------------------------------------------
 
 

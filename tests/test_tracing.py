@@ -1,0 +1,335 @@
+"""utils.tracing + trace.recorder: span model, the disabled fast path,
+attribute hygiene, the engine PhaseTimer gate, and the flight recorder's
+bounds (no cluster, no engine)."""
+import threading
+
+import pytest
+
+from mpcium_tpu.trace import recorder
+from mpcium_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Every test starts and ends with tracing disabled and clean
+    recorder state — armed tracing must never leak between tests."""
+    tracing.disable()
+    recorder.reset()
+    recorder.set_dump_dir(None)
+    yield
+    tracing.disable()
+    recorder.reset()
+    recorder.set_dump_dir(None)
+
+
+# -- span model ---------------------------------------------------------------
+
+
+def test_span_parent_and_trace_inheritance():
+    spans = []
+    tracing.enable(sink=spans.append)
+    with tracing.span("outer", trace_id="abc", node="node0", tid="s1") as o:
+        with tracing.span("inner") as i:
+            assert i.trace_id == "abc"
+            assert i.parent_id == o.span_id
+        # nested spans inherit the enclosing node/tid ("local"/"main"
+        # are the unset sentinels)
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner, outer = spans
+    assert inner["node"] == "node0" and inner["tid"] == "s1"
+    assert outer["parent_id"] is None
+    assert inner["t1_ns"] >= inner["t0_ns"]
+
+
+def test_span_ids_deterministic_no_entropy():
+    # trace ids are keyed hashes of public names: every node derives the
+    # same id for the same session without coordination
+    assert tracing.trace_id_for("sess-1") == tracing.trace_id_for("sess-1")
+    assert tracing.trace_id_for("sess-1") != tracing.trace_id_for("sess-2")
+    assert len(tracing.trace_id_for("x")) == 16
+
+
+def test_span_error_attribute_on_exception():
+    spans = []
+    tracing.enable(sink=spans.append)
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("nope")
+    assert spans[0]["attrs"]["error"] == "ValueError"
+
+
+def test_unknown_span_kwargs_become_attrs():
+    spans = []
+    tracing.enable(sink=spans.append)
+    with tracing.span("s", sender="node1", n=3):
+        pass
+    assert spans[0]["attrs"] == {"sender": "node1", "n": 3}
+
+
+def test_emit_retroactive_and_instant():
+    spans = []
+    tracing.enable(sink=spans.append)
+    tracing.emit("queue", 100, 200, node="n0", tid="lane:bulk", outcome="shed")
+    tracing.instant("intake", node="n0", tid="lane:bulk")
+    assert spans[0]["t0_ns"] == 100 and spans[0]["t1_ns"] == 200
+    assert spans[0]["attrs"]["outcome"] == "shed"
+    assert spans[1]["kind"] == "i"
+    assert spans[1]["t0_ns"] == spans[1]["t1_ns"]
+
+
+def test_current_ids_and_wire_context():
+    tracing.enable()
+    assert tracing.current_ids() is None
+    assert tracing.wire_context() is None
+    with tracing.span("s", trace_id="t1") as s:
+        assert tracing.current_ids() == ("t1", s.span_id)
+        assert tracing.wire_context() == {"t": "t1", "s": s.span_id}
+    assert tracing.wire_context() is None
+
+
+def test_thread_local_stacks_do_not_cross():
+    tracing.enable()
+    seen = {}
+
+    def other():
+        seen["ids"] = tracing.current_ids()
+
+    with tracing.span("main-span"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["ids"] is None
+
+
+# -- disabled fast path -------------------------------------------------------
+
+
+def test_disabled_everything_is_noop():
+    assert not tracing.enabled()
+    s = tracing.span("x", anything="goes")
+    assert s is tracing.NOOP_SPAN
+    with s:
+        s.set(a=1)
+    assert tracing.current_ids() is None
+    assert tracing.wire_context() is None
+    # emit/instant/incident return before building anything
+    tracing.emit("x", 0, 1)
+    tracing.instant("x")
+    tracing.incident("x")
+
+
+def test_disabled_span_is_shared_singleton():
+    assert tracing.span("a") is tracing.span("b")
+
+
+# -- attribute hygiene --------------------------------------------------------
+
+
+def test_clean_attrs_refuses_secret_names():
+    out = tracing.clean_attrs({
+        "nonce_share": "deadbeef", "secret_key": 1, "batch": 4,
+    })
+    assert out["nonce_share"] == "<refused:secret-name>"
+    assert out["secret_key"] == "<refused:secret-name>"
+    assert out["batch"] == 4
+
+
+def test_clean_attrs_reduces_objects_to_type_names():
+    class Opaque:
+        pass
+
+    out = tracing.clean_attrs({"thing": Opaque(), "xs": [1, 2]})
+    assert out["thing"] == "<obj:Opaque>"
+    assert out["xs"] == "<obj:list>"
+
+
+def test_declassify_requires_reason_and_unblocks_name():
+    with pytest.raises(ValueError):
+        tracing.declassify_attr("seed_label", "")
+    tracing.declassify_attr("seed_label", "chaos replay handle, not key material")
+    try:
+        out = tracing.clean_attrs({"seed_label": 7})
+        assert out["seed_label"] == 7
+        assert "seed_label" in tracing.declassified_attrs()
+    finally:
+        tracing._DECLASSIFIED_ATTRS.pop("seed_label", None)
+
+
+def test_span_attrs_are_screened_at_record_time():
+    spans = []
+    tracing.enable(sink=spans.append)
+    with tracing.span("s", priv_key="oops"):
+        pass
+    assert spans[0]["attrs"]["priv_key"] == "<refused:secret-name>"
+
+
+# -- PhaseTimer ---------------------------------------------------------------
+
+
+def test_phase_timer_disabled_never_syncs():
+    syncs = []
+    pt = tracing.PhaseTimer("eng", syncs.append)
+    assert not pt.on
+    pt.mark("phase1", object())
+    assert syncs == []
+
+
+def test_phase_timer_legacy_dict_without_tracing():
+    syncs = []
+    phases = {}
+    pt = tracing.PhaseTimer("eng", lambda ts: syncs.append(ts),
+                            phase_times=phases)
+    assert pt.on
+    pt.mark("r1", "tensor")
+    pt.mark("r2", "tensor", host=0.5, chunks=3.0, label="x")
+    assert len(syncs) == 2
+    assert set(phases) == {"r1", "r2", "r2_host", "r2_chunks"}
+    assert phases["r2_host"] == 0.5 and phases["r2_chunks"] == 3.0
+    assert phases["r1"] >= 0.0
+
+
+def test_phase_timer_spans_and_phase_share_roundtrip():
+    spans = []
+    tracing.enable(sink=spans.append)
+    pt = tracing.PhaseTimer("eng", lambda ts: None, node="engine", tid="e:B4")
+    pt.mark("r1")
+    pt.mark("r2", host=0.25)
+    share = tracing.phase_share(spans)
+    assert set(share) == {"r1", "r2", "r2_host"}
+    assert share["r2_host"] == 0.25
+    assert all(v >= 0.0 for v in share.values())
+    assert all(s["node"] == "engine" and s["tid"] == "e:B4" for s in spans)
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_recorder_bounded_with_exact_dropped_count():
+    rec = recorder.FlightRecorder("n0", capacity=8)
+    for i in range(20):
+        rec.record({"name": f"s{i}"})
+    spans, dropped = rec.snapshot()
+    assert len(spans) == 8
+    assert dropped == 12
+    assert spans[-1]["name"] == "s19"
+    # clear resets both the ring and the counter
+    spans, dropped = rec.snapshot(clear=True)
+    assert dropped == 12
+    assert rec.snapshot() == ([], 0)
+
+
+def test_record_routes_by_node():
+    recorder.record({"name": "a", "node": "node0"})
+    recorder.record({"name": "b", "node": "node1"})
+    recorder.record({"name": "c", "node": None})
+    snap = recorder.snapshot_all()
+    assert {n for n in snap} == {"node0", "node1", "local"}
+    assert snap["node0"][0][0]["name"] == "a"
+
+
+def test_reset_named_nodes_only():
+    recorder.record({"name": "a", "node": "node0"})
+    recorder.record({"name": "b", "node": "node1"})
+    recorder.reset(["node0"])
+    snap = recorder.snapshot_all()
+    assert "node0" not in snap and "node1" in snap
+
+
+def test_incident_fires_hook_and_dump_is_bounded(tmp_path):
+    tracing.enable(sink=recorder.record)
+    tracing.set_incident_hook(recorder.dump_incident)
+    recorder.set_dump_dir(str(tmp_path))
+    for i in range(recorder._DUMP_LIMIT + 5):
+        tracing.incident("shed", node="node0", reason="backpressure")
+    dumps = sorted(tmp_path.glob("trace_incident_*.json"))
+    assert len(dumps) == recorder._DUMP_LIMIT
+    import json
+
+    doc = json.loads(dumps[0].read_text())
+    assert doc["otherData"]["incident"] == "shed"
+    assert any(e["name"] == "incident:shed" for e in doc["traceEvents"])
+
+
+def test_incident_dump_never_raises_on_bad_dir():
+    tracing.enable(sink=recorder.record)
+    tracing.set_incident_hook(recorder.dump_incident)
+    recorder.set_dump_dir("/proc/definitely/not/writable")
+    tracing.incident("shed", node="node0")  # must not raise
+
+
+# -- utils.log: redaction + trace correlation ---------------------------------
+
+
+def _capture_json_log():
+    import logging
+
+    from mpcium_tpu.utils import log as ulog
+
+    lines = []
+
+    class _H(logging.Handler):
+        def emit(self, record):
+            lines.append(record.getMessage())
+
+    ulog.init(production=True, level="DEBUG")
+    ulog._logger.handlers[:] = [_H()]
+    return lines
+
+
+def test_log_safe_redacts_secret_typed_objects():
+    from mpcium_tpu.utils.log import _safe
+
+    class NonceShare:
+        def __repr__(self):
+            raise AssertionError("repr of secret-typed object must not run")
+
+    class Carrier:
+        def __init__(self):
+            self.secret_key = 42
+
+        def __repr__(self):
+            raise AssertionError("repr of secret-carrying object must not run")
+
+    class Boring:
+        def __init__(self):
+            self.batch = 4
+
+    assert _safe(NonceShare()) == "<redacted:NonceShare>"
+    assert _safe(Carrier()) == "<redacted:Carrier>"
+    assert _safe(Boring()).startswith("<")  # plain repr, not redacted
+    assert "redacted" not in _safe(Boring())
+    # scalars and bytes keep their existing behavior
+    assert _safe(b"\x01\x02") == "0102"
+    assert _safe("x") == "x" and _safe(3) == 3
+
+
+def test_log_safe_redacts_slots_carriers():
+    from mpcium_tpu.utils.log import _safe
+
+    class SlotCarrier:
+        __slots__ = ("pad_bytes",)
+
+        def __repr__(self):
+            raise AssertionError("must not repr")
+
+    assert _safe(SlotCarrier()) == "<redacted:SlotCarrier>"
+
+
+def test_log_records_carry_trace_ids_when_span_open():
+    import json as _json
+
+    from mpcium_tpu.utils import log as ulog
+
+    lines = _capture_json_log()
+    try:
+        tracing.enable()
+        ulog.info("before span", x=1)
+        with tracing.span("s", trace_id="t" * 16) as s:
+            ulog.info("inside span", x=2)
+        rec0 = _json.loads(lines[0])
+        rec1 = _json.loads(lines[1])
+        assert "trace_id" not in rec0
+        assert rec1["trace_id"] == "t" * 16
+        assert rec1["span_id"] == s.span_id
+    finally:
+        ulog.init()  # restore default handlers/mode
