@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install lint check trace-check test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -22,10 +22,23 @@ lint:
 
 # the one-pass static gate alone (mpclint + mpcflow + budget drift,
 # shared AST parse) — what CI calls between edit and test; the trace
-# gate rides along (--no-sweep: the sweep just ran)
+# gate rides along (--no-sweep: the sweep just ran), and perfcheck
+# (statistical micro-bench regression gate, <30 s, CPU-safe) closes it
 check:
 	$(PY) scripts/check_all.py
 	$(PY) scripts/trace_check.py --no-sweep
+	$(PY) scripts/perfcheck.py
+
+# statistical perf-regression gate alone (PERFORMANCE.md "perf
+# observatory"): micro-benches vs the committed PERF_baseline_micro.json
+# under a Mann-Whitney + effect-floor + bootstrap-CI triple gate.
+# --update-baseline re-anchors after an intentional perf change;
+# --regen-history rebuilds PERF_history.jsonl + PERFORMANCE_dashboard.md
+perfcheck:
+	$(PY) scripts/perfcheck.py
+
+perf-tests:
+	$(PY) -m pytest tests/ -m perf -q
 
 # mpctrace gate alone (OBSERVABILITY.md): committed TRACE_sample.json
 # validates + covers every instrumented layer, and a traced protocol

@@ -43,7 +43,6 @@ The host-only extension-stage microbench is scripts/bench_ot_host.py.
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import secrets
@@ -103,19 +102,12 @@ def _ensure_backend() -> str:
 def _host_fingerprint() -> str:
     """Short stable id for THIS host's CPU feature set. XLA:CPU AOT cache
     entries embed the compile machine's features; loading them on a
-    different machine (container live-migration) warns or crashes."""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    return hashlib.sha256(
-                        " ".join(sorted(line.split()[2:])).encode()
-                    ).hexdigest()[:12]
-    except OSError:
-        pass
-    import platform as _p
+    different machine (container live-migration) warns or crashes.
+    Delegates to perf/envfp (the canonical scheme the perf ledger groups
+    by); imported lazily so the pre-backend phase stays import-free."""
+    from mpcium_tpu.perf.envfp import host_fingerprint
 
-    return hashlib.sha256(_p.processor().encode() or b"?").hexdigest()[:12]
+    return host_fingerprint()
 
 
 def _cache_dir(platform: str) -> str:
@@ -366,19 +358,28 @@ def main() -> None:
     phases: dict = {}
     profiled_s = 0.0
     if platform == "tpu":
+        from mpcium_tpu.perf import profile as perf_profile
         from mpcium_tpu.utils import tracing
 
         _STATE["stage"] = "profiled_run"
         spans: list = []
+        profile_logdir = perf_profile.default_logdir(_HERE)
         tracing.enable(sink=spans.append)
         try:
-            t0 = time.perf_counter()
-            out = signer.sign(digests)
-            profiled_s = time.perf_counter() - t0
+            # MPCIUM_PROFILE=1 additionally captures the jax device
+            # timeline for this run; no-op context otherwise
+            with perf_profile.device_profile(profile_logdir) as profiling:
+                t0 = time.perf_counter()
+                out = signer.sign(digests)
+                profiled_s = time.perf_counter() - t0
         finally:
             tracing.disable()
         assert out["ok"].all()
         phases = tracing.phase_share(spans)
+        if profiling:
+            # fold per-phase device-op seconds from the captured profile
+            # into the phase table (keys <phase>_device_op_s)
+            phases.update(perf_profile.fold_device_ops(spans, profile_logdir))
 
     # timed runs (no internal sync)
     _STATE["stage"] = "timed_run"
@@ -404,6 +405,14 @@ def main() -> None:
         "phase_s": {k: round(v, 2) for k, v in phases.items()},
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
     }
+    # env fingerprint + compile ledger: which machine/toolchain/knob set
+    # produced this number (the perf ledger's grouping key) and what the
+    # warmup actually compiled vs deserialized from the persistent cache
+    from mpcium_tpu.perf import compile_watch
+    from mpcium_tpu.perf.envfp import env_fingerprint
+
+    record["env"] = env_fingerprint()
+    record["compile"] = compile_watch.health_summary()
     if platform == "cpu":
         last = _load_last_tpu_record()
         if last is not None and last.get("corrupt"):

@@ -392,6 +392,14 @@ class BatchSigningScheduler:
         )
         self._closed = False
 
+    def settled_size(self) -> int:
+        """Current entry count of the settled-digest TTL map — the
+        absorption window for post-dispatch redeliveries. Exposed as a
+        gauge so a leak here (entries not aging out) is visible before
+        the cap turns it into silent forgetting."""
+        with self._lock:
+            return len(self._settled)
+
     def close(self) -> None:
         self._closed = True
         self._sub.unsubscribe()

@@ -118,11 +118,26 @@ class EventConsumer:
         with self._lock:
             live_sessions = sum(len(ss) for ss in self._sessions.values())
             claims = len(self._claim_ts)
+        # refresh the observability gauges the snapshot should carry:
+        # flight-recorder ring drops, the settled-map size, and the
+        # compile ledger (all cheap; health is called at human cadence)
+        from ..perf import compile_watch
+        from ..trace import recorder
+
+        self.metrics.gauge("trace.dropped_spans").set(
+            float(recorder.recorder_for(self.node.node_id).dropped)
+        )
+        if self.scheduler is not None:
+            self.metrics.gauge("scheduler.settled_size").set(
+                float(self.scheduler.settled_size())
+            )
+        compile_watch.export_gauges(self.metrics)
         out = {
             "node": self.node.node_id,
             "live_sessions": live_sessions,
             "dedup_claims": claims,
             "batch_signing": self.scheduler is not None,
+            "compile": compile_watch.health_summary(),
             "metrics": self.metrics.snapshot(),
         }
         if self.scheduler is not None:

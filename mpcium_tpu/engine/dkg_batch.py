@@ -38,6 +38,7 @@ from ..core import hostmath as hm
 from ..core import secp256k1_jax as sp
 from ..core.bignum import P256
 from ..ops.sha256 import sha256 as dev_sha256
+from ..perf import compile_watch
 from ..protocol.base import KeygenShare, party_xs
 from ..utils import tracing
 
@@ -183,6 +184,7 @@ class BatchedDKG:
         _pt = tracing.PhaseTimer(
             "dkg.run", _trace_sync, node="engine", tid=f"dkg:B{B}",
         )
+        _cw = compile_watch.begin("dkg.run", f"B{B}|q{q}|{self.key_type}")
         xs_tuple = tuple(self.xs[p] for p in self.ids)
         coeffs = jnp.asarray(
             _rand_scalars((q, t + 1, B), order, self.rng)
@@ -239,6 +241,7 @@ class BatchedDKG:
                     )
                 )
         _pt.mark("aggregate_assemble")
+        compile_watch.finish(_cw)
         return out
 
 
@@ -277,6 +280,9 @@ class BatchedReshare:
         q_old = len(self.old_quorum)
         _pt = tracing.PhaseTimer(
             "reshare.run", _trace_sync, node="engine", tid=f"reshare:B{B}",
+        )
+        _cw = compile_watch.begin(
+            "reshare.run", f"B{B}|{self.key_type}|t{t_new}"
         )
         new_xs = party_xs(self.new_committee)
         xs_tuple = tuple(new_xs[p] for p in self.new_committee)
@@ -350,4 +356,5 @@ class BatchedReshare:
                     )
                 )
         _pt.mark("aggregate_assemble")
+        compile_watch.finish(_cw)
         return out

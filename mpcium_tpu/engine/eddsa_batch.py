@@ -33,6 +33,7 @@ from ..core import bignum as bn
 from ..core import ed25519_jax as ed
 from ..core import hostmath as hm
 from ..core.bignum import P256 as PROF
+from ..perf import compile_watch
 from ..utils import tracing
 
 
@@ -312,6 +313,7 @@ class BatchedCoSigners:
         _pt = tracing.PhaseTimer(
             "eddsa.sign", _trace_sync, node="engine", tid=f"eddsa:B{B}",
         )
+        _cw = compile_watch.begin("eddsa.sign", f"B{B}|q{q}")
 
         # -- round 1: nonce commitments (one (q, B) dispatch) + batch
         # commitments (native C++ SHA-256: one call per party, not B) ------
@@ -360,10 +362,12 @@ class BatchedCoSigners:
         # eddsa_signing_session.go:147) --------------------------------------
         ok = verify_signatures(sigs, jnp.asarray(self.A_comp), c64)
         _pt.mark("verify", ok)
-        return (
+        out = (
             np.asarray(sigs),  # mpcflow: host-ok — signature egress: final (R,s) leave device for callers
             np.asarray(ok & ok_R),  # mpcflow: host-ok — per-wallet verification verdicts, egress with the signatures
         )
+        compile_watch.finish(_cw)
+        return out
 
 
 def dealer_keygen_batch(

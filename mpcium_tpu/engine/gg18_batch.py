@@ -55,6 +55,7 @@ from ..core.paillier import PreParams
 from ..ops import modmul as mm
 from ..ops.paillier_mxu import RAND_BITS, PaillierMXUPrivate
 from ..ops.sha256 import sha256 as dev_sha256
+from ..perf import compile_watch
 from ..protocol.base import KeygenShare, party_xs
 from ..utils import log, tracing
 
@@ -1054,6 +1055,11 @@ class GG18BatchCoSigners:
             node="engine", tid=f"gg18:B{self.B}",
         )
         _mark = _pt.mark
+        # first call per (engine, shape-bucket) pays the compile wall:
+        # ledger it (one set lookup + None on every later call)
+        _cw = compile_watch.begin(
+            "gg18.sign", f"B{self.B}|q{self.q}|mta={self.mta_impl}"
+        )
         B, q = self.B, self.q
         ring = self.ring
         m = ring.reduce(
@@ -1121,10 +1127,12 @@ class GG18BatchCoSigners:
             _mark("r2_mta_ot",
                   *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs],
                   **ot_attrs)
-            return self._finish_sign(
+            out = self._finish_sign(
                 _mark, m, ok, k, gamma, Gamma, Gamma_comp,
                 g_commit, g_blind, alpha_shares, beta_shares,
             )
+            compile_watch.finish(_cw)
+            return out
 
         # per-party encryption of k_i (one ciphertext reused by all pairs)
         c_k, u_k, k_plain = [], [], []
@@ -1200,10 +1208,12 @@ class GG18BatchCoSigners:
                     _mod_q_from_limbs(sub["Rb"]["beta_prime"], mta.p_bp)
                 )
 
-        return self._finish_sign(
+        out = self._finish_sign(
             _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit, g_blind,
             alpha_shares, beta_shares,
         )
+        compile_watch.finish(_cw)
+        return out
 
     def _finish_sign(
         self, _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,

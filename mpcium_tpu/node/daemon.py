@@ -98,6 +98,14 @@ def run_node(
     # dumps (shed / timeout / drill failure) land under the db dir
     trace_arm(node_ids=[name],
               dump_dir=str(Path(cfg.db_dir) / name / "trace_incidents"))
+    # compile ledger: this node is alive but cold until boot completes —
+    # health publishes state=warming so a restart paying the compile
+    # wall is distinguishable from a dead node. The ledger file lands
+    # beside the node's stores.
+    from ..perf import compile_watch
+
+    compile_watch.mark_warming()
+    compile_watch.set_ledger_dir(str(Path(cfg.db_dir) / name))
     passphrase = cfg.passphrase or None
     if decrypt_private_key and passphrase is None:
         passphrase = getpass.getpass(f"passphrase for {name} identity key: ")
@@ -214,6 +222,10 @@ def run_node(
         target=health_loop, args=(consumer, control_kv, name, health_stop),
         name=f"health-{name}", daemon=True,
     ).start()
+    # every subsystem is wired and subscribed: flip the compile-ledger
+    # state to ready (shape warmups from live traffic keep accruing to
+    # the ledger; a future warm-start pass would run before this line)
+    compile_watch.mark_ready()
     log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")
 
     if not block:

@@ -1,0 +1,242 @@
+"""The compile-wall ledger: every jit warmup, written down where an
+operator can see it.
+
+The 802–1,401 s XLA compile wall (ROADMAP item 4) was measured nowhere
+but bench stdout. Engines now report every first-call-per-shape warmup
+here via the two-line ``begin``/``finish`` token protocol; each finish
+records a ledger entry::
+
+    {"engine": "gg18.sign", "shape": "B4096|q2|mta=ot", "platform":
+     "tpu", "compile_s": 802.1, "cache": "miss", "at": "..."}
+
+- persisted as ``COMPILE_LEDGER.json`` beside the XLA persistent cache
+  (or under an explicit ``set_ledger_dir`` — the daemon points it at
+  its db dir), append-on-every-finish so a crash mid-warmup still
+  leaves the completed entries on disk;
+- emitted as an mpctrace ``compile:<engine>`` span (node ``engine``,
+  tid ``compile``) so compile time lands on the same Perfetto timeline
+  as the device phases;
+- surfaced through ``health_summary()`` — the ``compile`` section of
+  daemon health — with a **warming/ready** state so a restarted node
+  (alive, paying the compile wall) is distinguishable from a dead one.
+  The ROADMAP-item-4 warm-start daemon will pre-warm shapes between
+  ``mark_warming()`` and ``mark_ready()``; today the daemon flips to
+  ready once boot completes and entries accrue as traffic compiles.
+
+Persistent-cache hit/miss: the XLA cache dir (when configured) is
+snapshotted at ``begin`` — new files at ``finish`` mean a real compile
+wrote artifacts (``miss``); none mean the executable deserialized from
+the persistent cache (``hit``); ``none`` means no cache dir was
+configured. Shape-bucket dedup is process-global: only the FIRST call
+per (engine, shape) pays the snapshot, every later call is one set
+lookup returning None.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils import tracing
+
+LEDGER_BASENAME = "COMPILE_LEDGER.json"
+
+_lock = threading.Lock()
+_seen: set = set()  # (engine, shape) shape-buckets already ledgered
+_entries: List[dict] = []
+_state = "ready"  # non-daemon default; run_node marks warming at boot
+_ledger_dir: Optional[str] = None  # explicit override (daemon db dir)
+
+
+class _Token:
+    __slots__ = ("engine", "shape", "t0", "t0_ns", "cache_dir",
+                 "files_before", "meta")
+
+    def __init__(self, engine: str, shape: str,
+                 meta: Dict[str, Any]) -> None:
+        self.engine = engine
+        self.shape = shape
+        self.meta = meta
+        self.cache_dir = _jax_cache_dir()
+        self.files_before = _count_files(self.cache_dir)
+        self.t0 = time.perf_counter()
+        self.t0_ns = tracing.now_ns()
+
+
+def _jax_cache_dir() -> Optional[str]:
+    """The configured XLA persistent-cache dir, read from an ALREADY
+    imported jax only — ledgering must never trigger a backend import."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001 — config shape varies across jax versions
+        return None
+
+
+def _platform() -> str:
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "unknown"
+    try:
+        devs = jax.devices()
+        return devs[0].platform if devs else "none"
+    except Exception:  # noqa: BLE001 — a wedged backend still gets a ledger entry
+        return "unknown"
+
+
+def _count_files(path: Optional[str]) -> Optional[int]:
+    if not path:
+        return None
+    try:
+        return sum(1 for n in os.listdir(path) if n != LEDGER_BASENAME)
+    except OSError:
+        return None
+
+
+def set_ledger_dir(path: Optional[str]) -> None:
+    """Explicit ledger location (the daemon points this at its db dir so
+    daemon-side compiles are ledgered even without a jax cache config)."""
+    global _ledger_dir
+    with _lock:
+        _ledger_dir = path
+
+
+def ledger_path() -> Optional[str]:
+    with _lock:
+        d = _ledger_dir
+    d = d or _jax_cache_dir()
+    return os.path.join(d, LEDGER_BASENAME) if d else None
+
+
+def begin(engine: str, shape: str, **meta: Any) -> Optional[_Token]:
+    """Open a warmup observation for (engine, shape). Returns None — one
+    set lookup, no timing — when this shape bucket was already ledgered
+    in this process, so steady-state calls cost nothing."""
+    key = (engine, shape)
+    with _lock:
+        if key in _seen:
+            return None
+        _seen.add(key)
+    return _Token(engine, shape, meta)
+
+
+def finish(token: Optional[_Token]) -> Optional[dict]:
+    """Close an observation: classify the persistent-cache outcome,
+    append the entry to the ledger (memory + JSON file), emit the
+    ``compile:<engine>`` span. Returns the entry (tests assert on it)."""
+    if token is None:
+        return None
+    elapsed = time.perf_counter() - token.t0
+    t1_ns = tracing.now_ns()
+    files_after = _count_files(token.cache_dir)
+    if token.files_before is None or files_after is None:
+        cache = "none"
+    elif files_after > token.files_before:
+        cache = "miss"
+    else:
+        cache = "hit"
+    entry = {
+        "engine": token.engine,
+        "shape": token.shape,
+        "platform": _platform(),
+        "compile_s": round(elapsed, 3),
+        "cache": cache,
+        "at": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
+    }
+    for k, v in token.meta.items():
+        if isinstance(v, (str, int, float, bool)):
+            entry.setdefault(k, v)
+    with _lock:
+        _entries.append(entry)
+        snapshot = list(_entries)
+    _write_ledger(snapshot)
+    tracing.emit(
+        f"compile:{token.engine}", token.t0_ns, t1_ns,
+        node="engine", tid="compile",
+        shape=token.shape, cache=cache,
+        compile_s=entry["compile_s"], platform=entry["platform"],
+    )
+    return entry
+
+
+def _write_ledger(snapshot: List[dict]) -> None:
+    path = ledger_path()
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"entries": snapshot}, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass  # ledgering must never take the engine down
+
+
+def entries() -> List[dict]:
+    with _lock:
+        return list(_entries)
+
+
+def mark_warming() -> None:
+    """Daemon boot: kernels for this node's shapes are not compiled yet.
+    A node publishing ``warming`` is alive-but-cold — the health state
+    that makes a restart distinguishable from a death."""
+    global _state
+    with _lock:
+        _state = "warming"
+
+
+def mark_ready() -> None:
+    global _state
+    with _lock:
+        _state = "ready"
+
+
+def health_summary() -> Dict[str, object]:
+    """The ``compile`` section of the health payload: warming/ready
+    state plus hit/miss/seconds accounting and the most recent entry."""
+    with _lock:
+        ents = list(_entries)
+        state = _state
+    hits = sum(1 for e in ents if e["cache"] == "hit")
+    misses = sum(1 for e in ents if e["cache"] == "miss")
+    return {
+        "state": state,
+        "compiles": len(ents),
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "total_compile_s": round(sum(e["compile_s"] for e in ents), 3),
+        "last": ents[-1] if ents else None,
+        "ledger": ledger_path(),
+    }
+
+
+def export_gauges(metrics, ready_states=("ready",)) -> None:
+    """Mirror the summary into a ``MetricsRegistry`` as gauges so the
+    daemon's Prometheus text carries the compile surface."""
+    s = health_summary()
+    metrics.gauge("compile.ready").set(
+        1.0 if s["state"] in ready_states else 0.0
+    )
+    metrics.gauge("compile.count").set(float(s["compiles"]))
+    metrics.gauge("compile.cache_hits").set(float(s["cache_hits"]))
+    metrics.gauge("compile.cache_misses").set(float(s["cache_misses"]))
+    metrics.gauge("compile.seconds_total").set(float(s["total_compile_s"]))
+
+
+def reset() -> None:
+    """Test hook: forget every shape bucket, entry, and state override."""
+    global _state, _ledger_dir
+    with _lock:
+        _seen.clear()
+        _entries.clear()
+        _state = "ready"
+        _ledger_dir = None

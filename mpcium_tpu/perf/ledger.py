@@ -1,0 +1,227 @@
+"""The bench trajectory ledger: committed perf artifacts, normalized.
+
+Five BENCH rounds, a soak, and five multichip dryruns sit in the repo
+as disconnected JSON files with four different shapes (driver-wrapped
+``{"n", "rc", "parsed"}`` rounds, raw on-chip records, soak reports,
+dryrun stubs). This module ingests every committed ``BENCH_*`` /
+``SOAK_*`` / ``MULTICHIP_*`` artifact into one normalized record
+stream — ``PERF_history.jsonl`` — keyed by an env-fingerprint group so
+CPU-degraded runs are structurally segregated from chip trends (the
+r05 stale-fallback confusion can no longer average into a trend line).
+
+Normalization is DETERMINISTIC from the artifact bytes: no wall clock,
+no host lookups — the committed history file is a pure function of the
+committed artifacts, so drift is a gate (`tests/test_perfcheck_gate`)
+exactly like HOST_TRANSFER_BUDGET.json.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from .envfp import fingerprint_key
+
+HISTORY_FILE = "PERF_history.jsonl"
+ARTIFACT_GLOBS = (
+    "BENCH_r*.json", "BENCH_TPU_*.json", "SOAK_*.json", "MULTICHIP_r*.json",
+)
+# scratch outputs that may sit untracked in a working tree
+_EXCLUDE = {"SOAK_local.json"}
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+# bench-record numeric fields that are metrics (rates) vs context
+_RATE_SUFFIXES = ("_per_sec", "_per_s")
+_CONTEXT_KEYS = (
+    "batch", "runs", "setup_s", "compile_s", "profiled_run_s",
+    "ed25519_batch", "dkg_batch", "reshare_batch", "gg18_ot_mta_batch",
+    "gg18_ot_mta_host_s", "gg18_ot_mta_device_s",
+    "gg18_ot_mta_overlap_ratio", "gg18_ot_mta_chunks",
+)
+
+
+def discover_artifacts(root: str) -> List[str]:
+    out = []
+    for pat in ARTIFACT_GLOBS:
+        for p in glob.glob(os.path.join(root, pat)):
+            if os.path.basename(p) not in _EXCLUDE:
+                out.append(p)
+    return sorted(set(out))
+
+
+def _round_of(name: str) -> Optional[int]:
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def _base_record(source: str, kind: str) -> dict:
+    return {
+        "source": source,
+        "kind": kind,
+        "round": _round_of(source),
+        "platform": "unknown",
+        "degraded": True,
+        "fingerprint": None,
+        "metrics": {},
+        "context": {},
+        "measured_at": None,
+        "notes": [],
+    }
+
+
+def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
+    platform = str(parsed.get("platform") or "unknown")
+    rec["platform"] = platform
+    rec["measured_at"] = parsed.get("measured_at")
+    value = parsed.get("value")
+    if parsed.get("watchdog_timeout"):
+        rec["notes"].append("watchdog fallback record — not a measurement")
+    metric = parsed.get("metric")
+    if metric is not None and isinstance(value, (int, float)):
+        rec["metrics"][metric] = float(value)
+    for k, v in parsed.items():
+        if k == "value" or not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.endswith(_RATE_SUFFIXES):
+            rec["metrics"][k] = float(v)
+        elif k in _CONTEXT_KEYS:
+            rec["context"][k] = v
+    if isinstance(parsed.get("mta"), str):
+        rec["context"]["mta"] = parsed["mta"]
+    if isinstance(parsed.get("phase_s"), dict) and parsed["phase_s"]:
+        if "no_spans" in parsed["phase_s"]:
+            rec["notes"].append("no spans recorded (watchdog/DNF run)")
+        else:
+            rec["context"]["phase_s"] = parsed["phase_s"]
+    env = parsed.get("env") if isinstance(parsed.get("env"), dict) else None
+    if env:
+        rec["env"] = env
+    rec["fingerprint"] = fingerprint_key(env, platform_hint=platform)
+    # degraded = anything that must never blend into a chip trend:
+    # off-chip platforms, watchdog zero-records, stale-fallback carriers
+    rec["degraded"] = (
+        platform != "tpu"
+        or not isinstance(value, (int, float))
+        or float(value or 0.0) <= 0.0
+        or bool(parsed.get("watchdog_timeout"))
+    )
+    if "last_tpu_measurement" in parsed:
+        rec["notes"].append(
+            "carries cached last_tpu_measurement (degraded-run rider; the "
+            "on-chip record is ingested from its own artifact)"
+        )
+
+
+def _normalize_bench(source: str, doc: dict) -> dict:
+    rec = _base_record(source, "bench")
+    if "parsed" in doc or "rc" in doc:  # driver-wrapped round artifact
+        rec["round"] = doc.get("n", rec["round"])
+        rec["context"]["rc"] = doc.get("rc")
+        parsed = doc.get("parsed")
+        if parsed is None:
+            rec["notes"].append(
+                f"DNF: rc={doc.get('rc')} with no parseable metric line"
+            )
+            rec["fingerprint"] = fingerprint_key(None)
+            return rec
+        _normalize_bench_parsed(rec, parsed)
+        return rec
+    _normalize_bench_parsed(rec, doc)  # raw on-chip record
+    return rec
+
+
+def _normalize_soak(source: str, doc: dict) -> dict:
+    rec = _base_record(source, "soak")
+    thr = doc.get("throughput") or {}
+    for k in ("sigs_per_s", "sigs_per_s_under_slo", "slo_hit_rate"):
+        if isinstance(thr.get(k), (int, float)):
+            rec["metrics"][k] = float(thr[k])
+    if isinstance(thr.get("duration_s"), (int, float)):
+        rec["context"]["duration_s"] = float(thr["duration_s"])
+    out = doc.get("outcomes") or {}
+    for k in ("submitted", "succeeded", "shed", "failed", "retries"):
+        if isinstance(out.get(k), (int, float)):
+            rec["context"][k] = out[k]
+    lat = doc.get("latency_ms") or {}
+    for lane, summ in sorted(lat.items()):
+        if isinstance(summ, dict):
+            for q in ("p50", "p99"):
+                if isinstance(summ.get(q), (int, float)):
+                    rec["metrics"][f"latency_{lane}_{q}_ms"] = float(summ[q])
+    rec["context"]["accounting_ok"] = bool(doc.get("accounting_ok"))
+    env = doc.get("env") if isinstance(doc.get("env"), dict) else None
+    if env:
+        rec["env"] = env
+        rec["platform"] = str(env.get("platform") or "unknown")
+    rec["fingerprint"] = fingerprint_key(env, platform_hint=rec["platform"])
+    rec["degraded"] = rec["platform"] != "tpu"
+    if rec["degraded"]:
+        rec["notes"].append(
+            "host-platform soak (compile-dominated latencies) — not a chip "
+            "serving number"
+        )
+    return rec
+
+
+def _normalize_multichip(source: str, doc: dict) -> dict:
+    rec = _base_record(source, "multichip")
+    ok = bool(doc.get("ok"))
+    rec["metrics"]["dryrun_ok"] = 1.0 if ok else 0.0
+    rec["context"]["n_devices"] = doc.get("n_devices")
+    rec["context"]["rc"] = doc.get("rc")
+    rec["context"]["skipped"] = bool(doc.get("skipped"))
+    rec["platform"] = "tpu" if ok else "unknown"
+    rec["degraded"] = not ok
+    if not ok:
+        rec["notes"].append("dryrun failed or had no devices")
+    rec["fingerprint"] = fingerprint_key(None, platform_hint=rec["platform"])
+    return rec
+
+
+def normalize(path: str) -> dict:
+    """One committed artifact → one normalized history record. Raises
+    on unreadable JSON — an artifact the ledger cannot parse is a gate
+    failure, not a silent skip."""
+    name = os.path.basename(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if name.startswith("SOAK_"):
+        return _normalize_soak(name, doc)
+    if name.startswith("MULTICHIP_"):
+        return _normalize_multichip(name, doc)
+    return _normalize_bench(name, doc)
+
+
+def build_history(root: str) -> List[dict]:
+    """Every committed artifact, normalized and deterministically
+    ordered (kind, round, source)."""
+    records = [normalize(p) for p in discover_artifacts(root)]
+    records.sort(key=lambda r: (r["kind"], r["round"] or 0, r["source"]))
+    return records
+
+
+def write_history(records: List[dict], path: str) -> None:
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def load_history(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def group_by_fingerprint(records: List[dict]) -> Dict[str, List[dict]]:
+    groups: Dict[str, List[dict]] = {}
+    for rec in records:
+        groups.setdefault(rec["fingerprint"] or "unknown/unstamped",
+                          []).append(rec)
+    return groups

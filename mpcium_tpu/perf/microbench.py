@@ -1,0 +1,134 @@
+"""The perfcheck micro-benches: fast, CPU-safe, hot-path-shaped.
+
+Each bench returns a list of per-sample wall seconds for statcheck to
+compare against the committed baseline. They are chosen to cover the
+layers a PR can silently slow down without touching a kernel:
+
+- ``field_mulmod``: host-side field arithmetic (the Python bignum path
+  every host verdict and reshare coefficient rides).
+- ``sha256_block``: host hashing throughput (commitments, OT pads —
+  the host half of ROADMAP item 2).
+- ``wheel_latency``: scheduler intake→dispatch timer latency through
+  the real ``_TimingWheel`` (PR 5's one-thread timer core).
+- ``span_overhead``: mpctrace span open/close cost with tracing armed
+  (PR 8's promise that observability stays cheap).
+
+No jax import anywhere: perfcheck must run in <30 s on a bare CPU
+host. Samples use best-of-k inner reps to shave scheduler noise off
+the floor; the statistics in statcheck absorb what remains.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from typing import Callable, Dict, List
+
+# secp256k1 field prime — the modulus the host math actually uses
+_P = 2**256 - 2**32 - 977
+
+DEFAULT_SAMPLES = 30
+
+
+def _timed_samples(fn: Callable[[], None], samples: int,
+                   best_of: int = 3) -> List[float]:
+    """Per sample: best wall time of ``best_of`` runs of ``fn`` — the
+    minimum estimates the noise-free cost; sample-to-sample spread is
+    what statcheck's rank test consumes."""
+    fn()  # warm caches/allocators outside the measurement
+    out = []
+    for _ in range(samples):
+        best = float("inf")
+        for _ in range(best_of):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best = dt
+        out.append(best)
+    return out
+
+
+def field_mulmod(samples: int = DEFAULT_SAMPLES, inner: int = 400) -> List[float]:
+    rng = random.Random(0xF1E1D)
+    xs = [rng.getrandbits(256) | 1 for _ in range(64)]
+
+    def body() -> None:
+        acc = 1
+        for i in range(inner):
+            acc = acc * xs[i & 63] % _P
+        if acc == 0:  # keep the loop un-eliminable
+            raise AssertionError("mulmod degenerated")
+
+    return _timed_samples(body, samples)
+
+
+def sha256_block(samples: int = DEFAULT_SAMPLES, kib: int = 96) -> List[float]:
+    block = bytes(range(256)) * (kib * 4)  # kib KiB of fixed bytes
+
+    def body() -> None:
+        hashlib.sha256(block).digest()
+
+    return _timed_samples(body, samples)
+
+
+def wheel_latency(samples: int = DEFAULT_SAMPLES) -> List[float]:
+    """Schedule→fire latency of the scheduler's timing wheel: the intake
+    →dispatch path's timer hop, measured on the real class. Imported
+    lazily — batch_scheduler pulls wire/session modules that a bare
+    statcheck import must not pay for."""
+    from ..consumers.batch_scheduler import _TimingWheel
+
+    wheel = _TimingWheel(name="perfcheck-wheel")
+    try:
+        out = []
+        fired = threading.Event()
+        wheel.schedule("warm", 0.0, fired.set)
+        fired.wait(2.0)
+        for i in range(samples):
+            fired = threading.Event()
+            t0 = time.perf_counter()
+            wheel.schedule(("s", i), 0.0, fired.set)
+            if not fired.wait(2.0):
+                raise RuntimeError("timing wheel never fired (perfcheck)")
+            out.append(time.perf_counter() - t0)
+        return out
+    finally:
+        wheel.close()
+
+
+def span_overhead(samples: int = DEFAULT_SAMPLES, inner: int = 400) -> List[float]:
+    """Cost of ``inner`` armed span open/closes into a null sink.
+    Tracing state is saved and restored — the bench must not leave the
+    process armed (or disarm a caller's recorder)."""
+    from ..utils import tracing
+
+    was_enabled = tracing.enabled()
+    prev_sink = tracing._sink
+
+    def body() -> None:
+        for _ in range(inner):
+            with tracing.span("perfcheck", kind="X"):
+                pass
+
+    tracing.enable(sink=lambda _s: None)
+    try:
+        return _timed_samples(body, samples)
+    finally:
+        if was_enabled:
+            tracing.enable(sink=prev_sink)
+        else:
+            tracing.disable()
+
+
+ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
+    "field_mulmod": field_mulmod,
+    "sha256_block": sha256_block,
+    "wheel_latency": wheel_latency,
+    "span_overhead": span_overhead,
+}
+
+
+def run_all(samples: int = DEFAULT_SAMPLES) -> Dict[str, List[float]]:
+    return {name: fn(samples) for name, fn in sorted(ALL_BENCHES.items())}

@@ -1,0 +1,127 @@
+"""Optional deep profiling: jax device timelines folded into mpctrace.
+
+``MPCIUM_PROFILE=1`` arms ``device_profile`` — a context manager around
+``jax.profiler.start_trace``/``stop_trace`` that captures the XLA
+device timeline for the wrapped region. ``fold_device_ops`` then walks
+the resulting ``*.trace.json.gz`` files, attributes device-op time to
+the mpctrace ``phase:`` spans whose window each op midpoint lands in,
+and returns ``{"<phase>_device_op_s": seconds}`` for the bench record —
+the host-side phase share and the on-chip op time in one table.
+
+Everything here is best-effort and fails to a no-op: profiling is a
+diagnostic lane, never a dependency of the measurement. Without the
+env knob (or without jax importable) ``device_profile`` yields without
+touching anything and ``fold_device_ops`` returns ``{}``.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional
+
+PROFILE_ENV = "MPCIUM_PROFILE"
+
+
+def profiling_enabled() -> bool:
+    return os.environ.get(PROFILE_ENV, "") == "1"
+
+
+@contextmanager
+def device_profile(logdir: str) -> Iterator[bool]:
+    """Capture a jax profiler trace into ``logdir`` for the enclosed
+    region. Yields True when a capture is actually running. No-op (and
+    yields False) when profiling is disabled or jax is unavailable."""
+    if not profiling_enabled():
+        yield False
+        return
+    try:
+        import jax.profiler as _profiler
+    except Exception:  # noqa: BLE001 — no jax, no profile; the run proceeds
+        yield False
+        return
+    try:
+        _profiler.start_trace(logdir)
+    except Exception:  # noqa: BLE001 — e.g. a second concurrent capture
+        yield False
+        return
+    try:
+        yield True
+    finally:
+        try:
+            _profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a failed stop must not mask the run
+            pass
+
+
+def _load_trace_events(logdir: str) -> List[dict]:
+    events: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True)):
+        try:
+            with gzip.open(path, "rt") as f:
+                doc = json.load(f)
+            events.extend(doc.get("traceEvents") or [])
+        except Exception:  # noqa: BLE001 — a torn capture file yields nothing
+            continue
+    return events
+
+
+def _device_pids(events: List[dict]) -> set:
+    """Pids whose process_name metadata names a device timeline (TPU/GPU
+    core lanes in the XLA trace; host threads stay excluded)."""
+    pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = str((e.get("args") or {}).get("name", "")).lower()
+            if any(t in pname for t in ("tpu", "gpu", "device", "/device:",
+                                        "xla")):
+                if "host" not in pname and "cpu" not in pname:
+                    pids.add(e.get("pid"))
+    return pids
+
+
+def fold_device_ops(spans: List[dict], logdir: str) -> Dict[str, float]:
+    """Attribute device-op time from a captured profile to the mpctrace
+    phase windows.
+
+    The profiler's clock and ``time.monotonic_ns`` share no epoch, so
+    the two timelines are aligned at their starts: min device-op ts ↔
+    min phase-span t0. Each complete ("X") device event whose midpoint
+    falls inside a phase window adds its duration to that phase's
+    ``<phase>_device_op_s``. Returns {} when there is nothing to fold
+    (no capture, no device pids, no phase spans) or on any parse error.
+    """
+    phases = [(s["name"][len("phase:"):], s["t0_ns"], s["t1_ns"])
+              for s in spans if s.get("name", "").startswith("phase:")]
+    if not phases:
+        return {}
+    events = _load_trace_events(logdir)
+    if not events:
+        return {}
+    dev_pids = _device_pids(events)
+    ops = [e for e in events
+           if e.get("ph") == "X" and e.get("pid") in dev_pids
+           and isinstance(e.get("ts"), (int, float))
+           and isinstance(e.get("dur"), (int, float))]
+    if not ops:
+        return {}
+    trace_t0_us = min(e["ts"] for e in ops)
+    span_t0_ns = min(t0 for _n, t0, _t1 in phases)
+    out: Dict[str, float] = {}
+    for e in ops:
+        mid_ns = span_t0_ns + int((e["ts"] - trace_t0_us + e["dur"] / 2.0)
+                                  * 1e3)
+        for name, t0, t1 in phases:
+            if t0 <= mid_ns < t1:
+                out[f"{name}_device_op_s"] = (
+                    out.get(f"{name}_device_op_s", 0.0) + e["dur"] / 1e6
+                )
+                break
+    return {k: round(v, 6) for k, v in out.items()}
+
+
+def default_logdir(root: Optional[str] = None) -> str:
+    return os.path.join(root or os.getcwd(), ".mpcium_profile")

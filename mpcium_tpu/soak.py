@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 from . import wire
 from .cluster import LocalCluster, load_test_preparams
+from .perf.envfp import env_fingerprint
 from .utils import log
 
 
@@ -455,6 +456,10 @@ class SoakRun:
             # reached EXACTLY ONE terminal outcome
             "accounting_ok": (pending == 0
                               and submitted == succeeded + shed + failed),
+            # env fingerprint (perf/envfp): which git sha / jax / host /
+            # knob set produced this number — the grouping key the perf
+            # ledger segregates trend lines by
+            "env": env_fingerprint(),
             # cluster-wide Prometheus text exposition (also written as a
             # .prom sidecar by scripts/load_soak.py) and the merged
             # cross-node flight-recorder trace (Perfetto-loadable)

@@ -120,14 +120,25 @@ def dump_incident(kind: str, node: str, attrs: dict) -> None:
     from .export import chrome_trace
 
     try:
+        snap = snapshot_all()
         doc = chrome_trace(
-            snapshot_all(),
+            snap,
             meta={"incident": kind, "node": node, "attrs": attrs},
         )
         os.makedirs(_dump_dir, exist_ok=True)
         fn = os.path.join(_dump_dir, f"trace_incident_{kind}_{n}.json")
         with open(fn, "w") as fh:
             json.dump(doc, fh)
+        from ..utils import log
+
+        # the summary line an operator greps before opening the JSON:
+        # how much history the dump holds and how much scrolled off
+        log.info(
+            "trace incident dumped", kind=kind, node=node, file=fn,
+            spans=sum(len(s) for s, _d in snap.values()),
+            ring_dropped={nid: d for nid, (_s, d) in sorted(snap.items())
+                          if d},
+        )
     except OSError:
         from ..utils import log
 

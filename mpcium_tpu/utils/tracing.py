@@ -395,7 +395,12 @@ def phase_share(spans: List[dict]) -> Dict[str, float]:
     ``phase:<name>`` -> ``{name: seconds}``, with numeric span attrs
     flattened as ``<name>_<attr>`` (the OT host/device split). This is
     how bench.py reproduces its phase-share fields from the trace
-    instead of the old private dict."""
+    instead of the old private dict.
+
+    A run that produced no phase spans (watchdog fallback, engine died
+    before its first mark) returns the explicit ``{"no_spans": 0.0}``
+    marker instead of an empty dict, so downstream merges keep their
+    keys and a reader can tell "nothing measured" from "lost"."""
     out: Dict[str, float] = {}
     for s in spans:
         if not s["name"].startswith("phase:"):
@@ -405,4 +410,6 @@ def phase_share(spans: List[dict]) -> Dict[str, float]:
         for k, v in s.get("attrs", {}).items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"{name}_{k}"] = v
+    if not out:
+        return {"no_spans": 0.0}
     return out

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""perfcheck: the statistical perf-regression gate (``make perfcheck``).
+
+Runs the CPU-safe micro-benches in ``mpcium_tpu.perf.microbench`` and
+compares them against the committed ``PERF_baseline_micro.json`` with
+the Mann-Whitney + effect-floor + bootstrap-CI triple gate in
+``mpcium_tpu.perf.statcheck``. Whole run stays under ~30 s.
+
+Host honesty: the baseline is stamped with the host fingerprint it was
+measured on. On a matching host the gate is STRICT (exit 1 on any
+regression, after one retry to absorb a transient CI-box spike). On a
+foreign host absolute timings are not comparable, so the comparison is
+reported informationally and never fails the build — the tier-1 test
+(`tests/test_perfcheck_gate.py`) still proves gate mechanics on every
+host via a freshly measured self-baseline.
+
+Flags:
+  --samples N          per-bench samples (default 30)
+  --update-baseline    re-measure and rewrite PERF_baseline_micro.json
+  --inject-slowdown F  multiply current samples by F (demonstrates the
+                       gate failing; used by CI self-test)
+  --regen-history      rebuild PERF_history.jsonl + the dashboard from
+                       the committed bench/soak/multichip artifacts
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from mpcium_tpu.perf import ledger, microbench, report, statcheck  # noqa: E402
+from mpcium_tpu.perf.envfp import host_fingerprint  # noqa: E402
+
+BASELINE_FILE = os.path.join(_ROOT, "PERF_baseline_micro.json")
+HISTORY_PATH = os.path.join(_ROOT, ledger.HISTORY_FILE)
+DASHBOARD_PATH = os.path.join(_ROOT, "PERFORMANCE_dashboard.md")
+
+
+def _load_baseline() -> dict | None:
+    try:
+        with open(BASELINE_FILE) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _write_baseline(samples: int) -> int:
+    benches = microbench.run_all(samples)
+    doc = {
+        "host": host_fingerprint(),
+        "python": ".".join(str(v) for v in sys.version_info[:3]),
+        "samples_per_bench": samples,
+        "benches": {name: {"samples": vals}
+                    for name, vals in sorted(benches.items())},
+    }
+    with open(BASELINE_FILE, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"perfcheck: baseline rewritten for host {doc['host']} "
+          f"-> {os.path.basename(BASELINE_FILE)}")
+    return 0
+
+
+def regen_history() -> int:
+    records = ledger.build_history(_ROOT)
+    ledger.write_history(records, HISTORY_PATH)
+    dashboard = report.render_dashboard(
+        records, micro_baseline=_load_baseline()
+    )
+    with open(DASHBOARD_PATH, "w") as f:
+        f.write(dashboard)
+    print(f"perfcheck: {len(records)} artifact records -> "
+          f"{os.path.basename(HISTORY_PATH)}, "
+          f"{os.path.basename(DASHBOARD_PATH)}")
+    return 0
+
+
+def _run_gate(baseline: dict, samples: int, slowdown: float,
+              strict: bool) -> statcheck.GateResult:
+    currents = microbench.run_all(samples)
+    if slowdown != 1.0:
+        currents = {k: [v * slowdown for v in vals]
+                    for k, vals in currents.items()}
+    baselines = {name: b.get("samples") or []
+                 for name, b in (baseline.get("benches") or {}).items()}
+    result = statcheck.gate(baselines, currents)
+    if strict and not result.ok:
+        # one retry absorbs a transient spike (another process pinning
+        # the box mid-measurement) without weakening the statistics: a
+        # real regression reproduces, a scheduler burp does not
+        retry_names = {v.bench for v in result.regressions}
+        print("perfcheck: regression indicated — re-measuring "
+              + ", ".join(sorted(retry_names)) + " once to confirm")
+        currents2 = {name: microbench.ALL_BENCHES[name](samples)
+                     for name in sorted(retry_names)
+                     if name in microbench.ALL_BENCHES}
+        if slowdown != 1.0:
+            currents2 = {k: [v * slowdown for v in vals]
+                         for k, vals in currents2.items()}
+        confirm = statcheck.gate(
+            {n: baselines[n] for n in currents2}, currents2
+        )
+        confirmed = {v.bench for v in confirm.regressions}
+        for v in result.verdicts:
+            if v.regressed and v.bench not in confirmed:
+                v.regressed = False
+                v.note = "regression not reproduced on retry"
+        for v in confirm.verdicts:
+            if v.regressed:
+                for orig in result.verdicts:
+                    if orig.bench == v.bench:
+                        orig.note = "confirmed on retry"
+    return result
+
+
+def main(argv: list | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--samples", type=int, default=microbench.DEFAULT_SAMPLES)
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--inject-slowdown", type=float, default=1.0,
+                    metavar="F", help="multiply measured samples by F "
+                    "(gate self-test)")
+    ap.add_argument("--regen-history", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.regen_history:
+        return regen_history()
+    if args.update_baseline:
+        return _write_baseline(args.samples)
+
+    baseline = _load_baseline()
+    if baseline is None:
+        print("perfcheck: no PERF_baseline_micro.json committed — run "
+              "--update-baseline first", file=sys.stderr)
+        return 1
+
+    here = host_fingerprint()
+    strict = baseline.get("host") == here
+    if not strict:
+        print(f"perfcheck: baseline host {baseline.get('host')} != this "
+              f"host {here} — informational comparison only (absolute "
+              "micro timings are not portable across hosts)")
+
+    result = _run_gate(baseline, args.samples, args.inject_slowdown, strict)
+    for v in result.verdicts:
+        print("perfcheck:", v.render())
+    for note in result.notes:
+        print("perfcheck: note:", note)
+
+    if not strict:
+        print("perfcheck: OK (foreign host — informational)")
+        return 0
+    if result.ok:
+        print("perfcheck: OK — no regressions")
+        return 0
+    print(f"perfcheck: FAIL — {len(result.regressions)} regression(s)",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Compile-wall ledger (perf/compile_watch.py): shape-bucket dedup,
+persistent-cache hit/miss classification, the on-disk ledger file, span
+emission (schema-valid through the trace export), warming→ready state,
+and the Prometheus gauge mirror."""
+import json
+import os
+
+import pytest
+
+from mpcium_tpu.perf import compile_watch
+from mpcium_tpu.trace.export import chrome_trace
+from mpcium_tpu.trace.schema import validate_chrome
+from mpcium_tpu.utils import tracing
+from mpcium_tpu.utils.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.perf
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    compile_watch.reset()
+    yield
+    compile_watch.reset()
+    tracing.disable()
+
+
+def test_first_call_per_shape_ledgers_then_dedups(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    tok = compile_watch.begin("gg18.sign", "B4|q2|mta=paillier")
+    assert tok is not None
+    entry = compile_watch.finish(tok)
+    assert entry["engine"] == "gg18.sign"
+    assert entry["shape"] == "B4|q2|mta=paillier"
+    assert entry["compile_s"] >= 0.0
+    # same bucket again: one set lookup, no token, no second entry
+    assert compile_watch.begin("gg18.sign", "B4|q2|mta=paillier") is None
+    # a DIFFERENT shape is a new bucket
+    assert compile_watch.begin("gg18.sign", "B8|q2|mta=paillier") is not None
+    assert len(compile_watch.entries()) == 1
+
+
+def test_finish_none_is_noop():
+    assert compile_watch.finish(None) is None
+    assert compile_watch.entries() == []
+
+
+def test_cache_miss_hit_none_classification(tmp_path, monkeypatch):
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    monkeypatch.setattr(compile_watch, "_jax_cache_dir",
+                        lambda: str(cache))
+    compile_watch.set_ledger_dir(str(tmp_path))
+
+    # miss: a new cache artifact appeared between begin and finish
+    tok = compile_watch.begin("e", "s1")
+    (cache / "artifact_0").write_text("x")
+    assert compile_watch.finish(tok)["cache"] == "miss"
+
+    # hit: cache dir exists, nothing new was written (deserialized)
+    tok = compile_watch.begin("e", "s2")
+    assert compile_watch.finish(tok)["cache"] == "hit"
+
+    # none: no cache dir configured at all
+    monkeypatch.setattr(compile_watch, "_jax_cache_dir", lambda: None)
+    tok = compile_watch.begin("e", "s3")
+    assert compile_watch.finish(tok)["cache"] == "none"
+
+
+def test_ledger_file_written_and_appended(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    compile_watch.finish(compile_watch.begin("e", "s1"))
+    compile_watch.finish(compile_watch.begin("e", "s2"))
+    path = os.path.join(str(tmp_path), compile_watch.LEDGER_BASENAME)
+    assert compile_watch.ledger_path() == path
+    with open(path) as f:
+        doc = json.load(f)
+    assert [e["shape"] for e in doc["entries"]] == ["s1", "s2"]
+
+
+def test_ledger_file_excluded_from_cache_counting(tmp_path, monkeypatch):
+    # the ledger lives INSIDE the XLA cache dir in the default layout;
+    # its own rewrite must never read as a cache miss
+    monkeypatch.setattr(compile_watch, "_jax_cache_dir",
+                        lambda: str(tmp_path))
+    compile_watch.finish(compile_watch.begin("e", "s1"))  # writes ledger
+    assert compile_watch.finish(compile_watch.begin("e", "s2"))["cache"] == "hit"
+
+
+def test_compile_span_emitted_and_schema_valid(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    spans = []
+    tracing.enable(sink=spans.append)
+    compile_watch.finish(compile_watch.begin("dkg.run", "B16|q3|ed25519"))
+    tracing.disable()
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["name"] == "compile:dkg.run"
+    assert s["node"] == "engine" and s["tid"] == "compile"
+    assert s["attrs"]["shape"] == "B16|q3|ed25519"
+    assert s["attrs"]["cache"] in ("hit", "miss", "none")
+    assert s["t1_ns"] >= s["t0_ns"]
+    validate_chrome(chrome_trace({"engine": (spans, 0)}))
+
+
+def test_no_span_when_tracing_disabled(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    entry = compile_watch.finish(compile_watch.begin("e", "s"))
+    assert entry is not None  # ledger entry regardless of tracing
+
+
+def test_warming_ready_state_and_health_summary(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    assert compile_watch.health_summary()["state"] == "ready"  # non-daemon
+    compile_watch.mark_warming()
+    assert compile_watch.health_summary()["state"] == "warming"
+    compile_watch.finish(compile_watch.begin("e", "s"))
+    compile_watch.mark_ready()
+    h = compile_watch.health_summary()
+    assert h["state"] == "ready"
+    assert h["compiles"] == 1
+    assert h["cache_hits"] + h["cache_misses"] <= 1
+    assert h["total_compile_s"] >= 0.0
+    assert h["last"]["shape"] == "s"
+    assert h["ledger"].endswith(compile_watch.LEDGER_BASENAME)
+
+
+def test_export_gauges_mirror(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    compile_watch.mark_warming()
+    compile_watch.finish(compile_watch.begin("e", "s"))
+    m = MetricsRegistry()
+    compile_watch.export_gauges(m)
+    g = m.snapshot()["gauges"]
+    assert g["compile.ready"] == 0.0
+    assert g["compile.count"] == 1.0
+    compile_watch.mark_ready()
+    compile_watch.export_gauges(m)
+    assert m.snapshot()["gauges"]["compile.ready"] == 1.0
+
+
+def test_engine_hooks_ledger_real_sign(tmp_path):
+    """End-to-end: a real (tiny) eddsa batch sign lands exactly one
+    ledger entry per shape bucket, with repeat signs deduplicated."""
+    import secrets
+
+    from mpcium_tpu.engine import eddsa_batch as eb
+
+    compile_watch.set_ledger_dir(str(tmp_path))
+    ids = ["node0", "node1", "node2"]
+    shares = eb.dealer_keygen_batch(2, ids, 1, rng=secrets)
+    signer = eb.BatchedCoSigners(ids[:2], shares[:2], rng=secrets)
+    msgs = [secrets.token_bytes(32) for _ in range(2)]
+    _sigs, ok = signer.sign(msgs)
+    assert ok.all()
+    _sigs, ok = signer.sign(msgs)  # second call: dedup, no new entry
+    assert ok.all()
+    ents = [e for e in compile_watch.entries() if e["engine"] == "eddsa.sign"]
+    assert len(ents) == 1
+    assert ents[0]["shape"] == "B2|q2"

@@ -70,6 +70,44 @@ def test_health_loop_republishes_periodically():
     assert seen.count("health/node0.prom") >= 3
 
 
+def test_health_payload_tracks_compile_warming_to_ready():
+    """The compile-wall section rides the health beat: a daemon that is
+    still tracing its first XLA compiles publishes state=warming, then
+    flips to ready — visible to anything polling health/<node>."""
+    from mpcium_tpu.perf import compile_watch
+
+    class _CompileAwareConsumer(_StubConsumer):
+        def health(self):
+            compile_watch.export_gauges(self.metrics)
+            h = super().health()
+            h["compile"] = compile_watch.health_summary()
+            return h
+
+    compile_watch.reset()
+    try:
+        kv = MemoryKV()
+        consumer = _CompileAwareConsumer()
+
+        compile_watch.mark_warming()
+        compile_watch.finish(compile_watch.begin("dkg.run", "B4|q3|ecdsa"))
+        snap = publish_health(consumer, kv, "node0")
+        assert snap["compile"]["state"] == "warming"
+        assert snap["compile"]["compiles"] == 1
+        stored = json.loads(kv.get("health/node0"))
+        assert stored["compile"]["state"] == "warming"
+        assert stored["metrics"]["gauges"]["compile.ready"] == 0.0
+
+        compile_watch.mark_ready()
+        snap = publish_health(consumer, kv, "node0")
+        assert snap["compile"]["state"] == "ready"
+        stored = json.loads(kv.get("health/node0"))
+        assert stored["metrics"]["gauges"]["compile.ready"] == 1.0
+        prom = kv.get("health/node0.prom").decode()
+        assert 'compile_ready{node="node0"} 1.0' in prom
+    finally:
+        compile_watch.reset()
+
+
 def test_health_loop_survives_kv_put_raise():
     consumer = _StubConsumer()
     stop = threading.Event()

@@ -1,0 +1,89 @@
+"""Deep-profiling fold (perf/profile.py): device-op attribution from a
+synthetic jax-profiler capture, the env gate, and fail-to-noop paths."""
+import gzip
+import json
+import os
+
+import pytest
+
+from mpcium_tpu.perf import profile
+
+pytestmark = pytest.mark.perf
+
+
+def _phase_span(name, t0_ns, t1_ns):
+    return {"name": f"phase:{name}", "t0_ns": t0_ns, "t1_ns": t1_ns,
+            "trace_id": "t", "span_id": "s", "parent_id": None,
+            "node": "engine", "tid": "main", "kind": "X", "attrs": {}}
+
+
+def _write_capture(logdir, events):
+    d = os.path.join(logdir, "plugins", "profile", "run1")
+    os.makedirs(d)
+    path = os.path.join(d, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_profiling_disabled_by_default(monkeypatch):
+    monkeypatch.delenv(profile.PROFILE_ENV, raising=False)
+    assert not profile.profiling_enabled()
+    with profile.device_profile("/nonexistent") as on:
+        assert on is False
+    monkeypatch.setenv(profile.PROFILE_ENV, "1")
+    assert profile.profiling_enabled()
+
+
+def test_fold_attributes_device_ops_to_phase_windows(tmp_path):
+    # two phases: [0, 1ms) and [1ms, 3ms) on the span clock
+    spans = [_phase_span("r1", 1_000_000, 2_000_000),
+             _phase_span("r2", 2_000_000, 4_000_000)]
+    # profiler clock starts at ts=500us; alignment maps 500us -> span
+    # min t0 (1ms). Op A midpoint lands in r1, op B in r2.
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7, "tid": 0,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 9, "tid": 0,
+         "args": {"name": "python host threads"}},
+        # op A: [500us, 900us) on profiler clock -> [1.0ms, 1.4ms) spans
+        {"ph": "X", "name": "fusion.1", "pid": 7, "tid": 1,
+         "ts": 500.0, "dur": 400.0},
+        # op B: [1600us, 2600us) -> [2.1ms, 3.1ms), midpoint in r2
+        {"ph": "X", "name": "fusion.2", "pid": 7, "tid": 1,
+         "ts": 1600.0, "dur": 1000.0},
+        # host-pid op must be ignored even though it overlaps r1
+        {"ph": "X", "name": "host_op", "pid": 9, "tid": 1,
+         "ts": 500.0, "dur": 400.0},
+    ]
+    _write_capture(str(tmp_path), events)
+    out = profile.fold_device_ops(spans, str(tmp_path))
+    assert out == {"r1_device_op_s": pytest.approx(400 / 1e6),
+                   "r2_device_op_s": pytest.approx(1000 / 1e6)}
+
+
+def test_fold_returns_empty_on_missing_pieces(tmp_path):
+    spans = [_phase_span("r1", 0, 1_000_000)]
+    # no capture files at all
+    assert profile.fold_device_ops(spans, str(tmp_path)) == {}
+    # capture but no phase spans
+    _write_capture(str(tmp_path), [
+        {"ph": "M", "name": "process_name", "pid": 7, "tid": 0,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "f", "pid": 7, "tid": 1, "ts": 0.0,
+         "dur": 10.0},
+    ])
+    assert profile.fold_device_ops([], str(tmp_path)) == {}
+
+
+def test_fold_survives_torn_capture_file(tmp_path):
+    spans = [_phase_span("r1", 0, 1_000_000)]
+    d = os.path.join(str(tmp_path), "run")
+    os.makedirs(d)
+    with open(os.path.join(d, "bad.trace.json.gz"), "wb") as f:
+        f.write(b"not gzip at all")
+    assert profile.fold_device_ops(spans, str(tmp_path)) == {}
+
+
+def test_default_logdir_is_repo_scoped():
+    assert profile.default_logdir("/some/root") == \
+        "/some/root/.mpcium_profile"

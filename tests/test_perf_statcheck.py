@@ -1,0 +1,106 @@
+"""Statistical behavior of the perfcheck gate (perf/statcheck.py).
+
+The gate's whole value is its error rates: on IDENTICAL distributions it
+must almost never fire (seeded false-positive sweep), and on an injected
+1.5x slowdown it must ALWAYS fire (false-negative sweep). Both sweeps
+use synthetic noise from seeded RNGs so the assertions are exact and
+replayable, not themselves flaky timing tests.
+"""
+import random
+
+import pytest
+
+from mpcium_tpu.perf import statcheck
+
+pytestmark = pytest.mark.perf
+
+_N = 30  # matches microbench.DEFAULT_SAMPLES
+
+
+def _noisy(seed: int, n: int = _N, mu: float = 1.0, sigma: float = 0.08):
+    rng = random.Random(seed)
+    return [abs(rng.gauss(mu, sigma)) for _ in range(n)]
+
+
+def test_identical_distributions_pass_on_at_least_99pct_of_seeds():
+    regressions = 0
+    seeds = 200
+    for seed in range(seeds):
+        base = _noisy(seed * 2 + 1)
+        cur = _noisy(seed * 2 + 2)  # same distribution, independent draw
+        if statcheck.compare("x", base, cur, seed=seed).regressed:
+            regressions += 1
+    # triple gate (rank test AND >=25% effect AND CI_lo > 1) on equal
+    # distributions: the effect floor alone makes firing vanishingly
+    # rare; allow 2/200 so one unlucky seed pair cannot flake CI
+    assert regressions <= 2, f"{regressions}/{seeds} false positives"
+
+
+def test_injected_slowdown_always_fails():
+    for seed in range(50):
+        base = _noisy(seed * 2 + 1)
+        cur = [v * 1.5 for v in _noisy(seed * 2 + 2)]
+        v = statcheck.compare("x", base, cur, seed=seed)
+        assert v.regressed, f"seed {seed} missed a 1.5x slowdown: {v.render()}"
+
+
+def test_constant_tied_distributions_pass():
+    # a fully tied pool has zero rank variance: indistinguishable, not a
+    # regression (and no ZeroDivisionError)
+    v = statcheck.compare("x", [1.0] * _N, [1.0] * _N)
+    assert not v.regressed
+    assert v.p_value == 1.0
+    assert v.ratio == 1.0
+
+
+def test_effect_floor_blocks_small_but_significant_slowdowns():
+    # 10% slower with tiny noise: statistically unambiguous (p ~ 0) but
+    # below the 25% practical-effect floor — must NOT fail the gate
+    base = _noisy(1, sigma=0.001)
+    cur = [v * 1.10 for v in _noisy(2, sigma=0.001)]
+    v = statcheck.compare("x", base, cur)
+    assert v.p_value < 1e-6
+    assert not v.regressed
+
+
+def test_speedups_never_fail():
+    base = _noisy(3)
+    cur = [v * 0.5 for v in _noisy(4)]
+    v = statcheck.compare("x", base, cur)
+    assert not v.regressed
+    assert v.ratio < 1.0
+
+
+def test_bootstrap_ci_is_seeded_and_brackets_true_ratio():
+    base = _noisy(5)
+    cur = [v * 1.5 for v in _noisy(6)]
+    ci1 = statcheck.bootstrap_ratio_ci(base, cur, seed=7)
+    ci2 = statcheck.bootstrap_ratio_ci(base, cur, seed=7)
+    assert ci1 == ci2  # deterministic, replayable verdicts
+    assert ci1[0] < 1.5 < ci1[1] or abs(ci1[0] - 1.5) < 0.2
+
+
+def test_gate_reports_one_sided_benches_as_notes():
+    res = statcheck.gate(
+        {"both": _noisy(1), "baseline_only": _noisy(2)},
+        {"both": _noisy(3), "current_only": _noisy(4)},
+    )
+    assert [v.bench for v in res.verdicts] == ["both"]
+    assert any("baseline_only" in n for n in res.notes)
+    assert any("current_only" in n for n in res.notes)
+    assert res.ok
+
+
+def test_mann_whitney_is_one_sided():
+    base = _noisy(8)
+    fast = [v * 0.5 for v in _noisy(9)]
+    # current FASTER than baseline → p near 1 (we only test "slower")
+    assert statcheck.mann_whitney_p(base, fast) > 0.5
+    assert statcheck.mann_whitney_p(fast, base) < 1e-6
+
+
+def test_empty_samples_raise():
+    with pytest.raises(ValueError):
+        statcheck.median([])
+    with pytest.raises(ValueError):
+        statcheck.mann_whitney_p([], [1.0])
