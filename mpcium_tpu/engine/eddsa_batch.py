@@ -6,9 +6,11 @@ axis): each MPC party coalesces the round compute of B concurrent signing
 sessions into single fixed-shape XLA dispatches. The protocol is the same
 commit–reveal threshold Schnorr as ``protocol.eddsa.signing`` (3 rounds,
 matching reference pkg/mpc/eddsa_rounds.go:23-25); here the per-round math
-runs on device over ``(B, …)`` tensors while hashing (commitments, the
-RFC 8032 challenge) stays host-side — hashing is control-plane (SURVEY.md
-§7.2 step 2).
+runs on device over ``(B, …)`` tensors, and since the device hash suite
+(ops.hash_suite) the hashing does too: commitments batch through the
+SHA-256 kernel and the RFC 8032 challenge through the 64-bit-lane
+SHA-512 kernel, so the round tensors never round-trip through the host
+(MPCIUM_EDDSA_DEVICE_HASH=0 restores the native/hashlib path).
 
 Wire format for batched rounds is *byte tensors*, not JSON: a party's
 round-1 message is the (B, 32) array of compressed nonce commitments, etc.
@@ -22,6 +24,7 @@ sessions; the `ok` masks make padding harmless).
 from __future__ import annotations
 
 import hashlib
+import os
 import secrets
 from typing import Sequence, Tuple
 
@@ -33,6 +36,7 @@ from ..core import bignum as bn
 from ..core import ed25519_jax as ed
 from ..core import hostmath as hm
 from ..core.bignum import P256 as PROF
+from ..ops import hash_suite as hs
 from ..perf import compile_watch
 from ..utils import tracing
 
@@ -193,25 +197,54 @@ def fused_sign_step(
 # ---------------------------------------------------------------------------
 
 
+def device_hash_enabled() -> bool:
+    """MPCIUM_EDDSA_DEVICE_HASH gates the device hash path (default ON):
+    commitments and the RFC 8032 challenge hash through ops.hash_suite's
+    SHA-256/SHA-512 kernels where the round tensors already live. Set to
+    0 to restore the native C++ / hashlib host path (which stays the
+    reference oracle — all paths are byte-identical)."""
+    return os.environ.get("MPCIUM_EDDSA_DEVICE_HASH", "1") != "0"
+
+
+def challenge_device(R_comp, A_comp, M) -> jnp.ndarray:
+    """Device challenge hashes: SHA-512(R ‖ A ‖ M) over (B, 32)/(B, 32)/
+    (B, L) uint8 rows (device or host) → (B, 64) device digests, one
+    fused dispatch through the 64-bit-lane kernel. The batch engine calls
+    this directly so c64 never leaves the device."""
+    msg = jnp.concatenate(
+        [jnp.asarray(R_comp), jnp.asarray(A_comp), jnp.asarray(M)], axis=-1
+    )
+    return hs.sha512(msg)
+
+
 def challenge_hashes(
     R_comp: np.ndarray, A_comp: np.ndarray, messages: Sequence[bytes]
 ) -> np.ndarray:
     """Per-session SHA-512(R ‖ A ‖ M) → (B, 64) uint8.
 
-    Equal-length messages (the common case: 32-byte tx digests) hash as ONE
-    native batch call (native.batch_sha512 — C++, one call per batch
-    instead of B Python hashlib calls); ragged batches fall back per row.
+    Equal-length messages (the common case: 32-byte tx digests) hash on
+    device as ONE fused dispatch (:func:`challenge_device`);
+    MPCIUM_EDDSA_DEVICE_HASH=0 falls back to the native C++ batch call
+    and ragged batches fall back to per-row hashlib. All three paths are
+    byte-identical (tests/test_hash_suite.py, tests/test_eddsa_batch.py).
     """
     from .. import native
 
-    R = np.asarray(R_comp)
-    A = np.asarray(A_comp)
     lens = {len(m) for m in messages}
     if len(lens) == 1:
         M = np.frombuffer(b"".join(messages), dtype=np.uint8).reshape(
             len(messages), lens.pop()
         )
-        return native.batch_sha512(b"", np.concatenate([R, A, M], axis=1))
+        if device_hash_enabled():
+            return np.asarray(challenge_device(R_comp, A_comp, M))  # mpcflow: host-ok — host-facing helper egress; the batch engine uses challenge_device and keeps c64 on device
+        return native.batch_sha512(
+            b"",
+            np.concatenate(
+                [np.asarray(R_comp), np.asarray(A_comp), M], axis=1  # mpcflow: host-ok — MPCIUM_EDDSA_DEVICE_HASH=0 fallback: the native batch hasher reads host rows
+            ),
+        )
+    R = np.asarray(R_comp)  # mpcflow: host-ok — ragged-message fallback: per-row hashlib reads host bytes
+    A = np.asarray(A_comp)  # mpcflow: host-ok — ragged-message fallback: per-row hashlib reads host bytes
     out = np.empty((len(messages), 64), dtype=np.uint8)
     for i, m in enumerate(messages):
         out[i] = np.frombuffer(
@@ -300,6 +333,7 @@ class BatchedCoSigners:
                 for s in party_shares[0]
             ]
         )
+        self._A_dev = jnp.asarray(self.A_comp)  # uploaded once, reused every batch
 
     def sign(self, messages: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
         """Run the full 3-round protocol for B sessions → ((B, 64)
@@ -316,40 +350,75 @@ class BatchedCoSigners:
         _cw = compile_watch.begin("eddsa.sign", f"B{B}|q{q}")
 
         # -- round 1: nonce commitments (one (q, B) dispatch) + batch
-        # commitments (native C++ SHA-256: one call per party, not B) ------
+        # commitments (device SHA-256 over the (q, B) rows where R
+        # already lives; MPCIUM_EDDSA_DEVICE_HASH=0 restores the native
+        # C++ per-party calls) ------------------------------------------------
         from .. import native
 
         r64 = np.stack([fresh_nonce_bytes(B, self.rng) for _ in range(q)])
         r_limbs, R_comp = nonce_commitments(jnp.asarray(r64))  # (q,B,22)/(q,B,32)
-        R_host = np.asarray(R_comp)
-        blinds = [
+        use_dev_hash = device_hash_enabled()
+        blinds = np.stack([
             np.frombuffer(self.rng.token_bytes(B * 32), dtype=np.uint8)
             .reshape(B, 32) for _ in range(q)
-        ]
-        commits = [
-            native.batch_sha256(
-                b"mpcium-tpu/eddsa-commit",
-                np.concatenate([blinds[p], R_host[p]], axis=1),
+        ])
+        if use_dev_hash:
+            pref = jnp.asarray(
+                np.frombuffer(b"mpcium-tpu/eddsa-commit", np.uint8)
             )
-            for p in range(q)
-        ]
-        _pt.mark("r1_nonce_commit")
+            commit_msg = jnp.concatenate(
+                [
+                    jnp.broadcast_to(pref, (q, B) + pref.shape),
+                    jnp.asarray(blinds),
+                    R_comp,
+                ],
+                axis=-1,
+            )
+            commits = hs.sha256(commit_msg)
+        else:
+            R_host = np.asarray(R_comp)  # mpcflow: host-ok — MPCIUM_EDDSA_DEVICE_HASH=0 fallback: native hasher reads host rows; the default device path keeps R on device
+            commits = [
+                native.batch_sha256(
+                    b"mpcium-tpu/eddsa-commit",
+                    np.concatenate([blinds[p], R_host[p]], axis=1),
+                )
+                for p in range(q)
+            ]
+        _pt.mark("r1_nonce_commit", commits)
 
-        # -- round 2: decommit + verify (batch hash check, device aggregate)
-        for p in range(q):
-            again = native.batch_sha256(
-                b"mpcium-tpu/eddsa-commit",
-                np.concatenate([blinds[p], R_host[p]], axis=1),
-            )
-            if not (again == commits[p]).all():
+        # -- round 2: decommit + verify (re-hash the received tensors,
+        # one fraud verdict; device aggregate) --------------------------------
+        if use_dev_hash:
+            again = hs.sha256(commit_msg)
+            fraud_free = np.asarray(jnp.all(again == commits))  # mpcflow: host-ok — commitment-fraud verdict egress (one bool)
+            if not fraud_free:
                 raise RuntimeError("commitment fraud detected")
-        R_sum, ok_R = aggregate_nonce(jnp.asarray(R_host))
+            R_sum, ok_R = aggregate_nonce(R_comp)
+        else:
+            for p in range(q):
+                again = native.batch_sha256(
+                    b"mpcium-tpu/eddsa-commit",
+                    np.concatenate([blinds[p], R_host[p]], axis=1),
+                )
+                if not (again == commits[p]).all():
+                    raise RuntimeError("commitment fraud detected")
+            R_sum, ok_R = aggregate_nonce(jnp.asarray(R_host))
         _pt.mark("r2_decommit_aggregate", R_sum)
 
-        # -- round 3: challenge (host hash) + partials (one (q, B) dispatch)
-        c64 = jnp.asarray(
-            challenge_hashes(np.asarray(R_sum), self.A_comp, messages)
-        )
+        # -- round 3: challenge (device SHA-512, fused; ragged messages
+        # fall back to the host hasher) + partials (one (q, B) dispatch)
+        lens = {len(m) for m in messages}
+        if use_dev_hash and len(lens) == 1:
+            Mrows = np.frombuffer(b"".join(messages), np.uint8).reshape(
+                B, lens.pop()
+            )
+            c64 = challenge_device(R_sum, self._A_dev, Mrows)
+        else:
+            c64 = jnp.asarray(
+                challenge_hashes(
+                    np.asarray(R_sum), self.A_comp, messages  # mpcflow: host-ok — ragged-message fallback: per-row hashlib reads host bytes; the equal-length default stays on device
+                )
+            )
         parts = partial_signature(
             r_limbs,
             jnp.broadcast_to(c64, (q,) + c64.shape),
@@ -360,7 +429,7 @@ class BatchedCoSigners:
 
         # -- local verification before publishing (reference
         # eddsa_signing_session.go:147) --------------------------------------
-        ok = verify_signatures(sigs, jnp.asarray(self.A_comp), c64)
+        ok = verify_signatures(sigs, self._A_dev, c64)
         _pt.mark("verify", ok)
         out = (
             np.asarray(sigs),  # mpcflow: host-ok — signature egress: final (R,s) leave device for callers

@@ -8,113 +8,33 @@ Here the whole batch hashes as one fused device dispatch: 32-bit message
 schedule + compression expressed over (B,) uint32 lanes, messages padded to
 a static block count at trace time.
 
+The Merkle–Damgård core now lives in :mod:`ops.hash_suite` (it also
+powers the device SHA-512, the IKNP PRG expansion and the OT pad
+hashing — ROADMAP item 2); this module keeps the original public
+surface and delegates.
+
 Reference correspondence: replaces the per-session SHA-256 commitments the
 reference gets from Go crypto/sha256 via tss-lib (commitment scheme used in
 GG18 rounds; SURVEY.md §2.3).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
-import numpy as np
-from jax import lax
 
-_K = np.array([
-    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
-    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
-    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
-    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
-    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
-    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
-    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
-    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
-    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
-    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
-    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
-    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
-    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
-    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
-    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
-], dtype=np.uint32)
+from .hash_suite import (  # noqa: F401 — re-exported compatibility surface
+    _H256 as _H0,
+    _K256 as _K,
+    _rotr32 as _rotr,
+    bytes_to_words32 as _bytes_to_words,
+    sha256_compress as _compress,
+    sha256_fixed as _sha256_fixed,
+    words32_to_bytes as _words_to_bytes,
+)
 
-_H0 = np.array([
-    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
-    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
-], dtype=np.uint32)
-
-
-def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    return (x >> n) | (x << (32 - n))
-
-
-def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
-    """state (..., 8) uint32, block (..., 16) uint32 → new state."""
-
-    def sched(carry_w, _):
-        w = carry_w  # (..., 16) rolling window
-        s0 = _rotr(w[..., 1], 7) ^ _rotr(w[..., 1], 18) ^ (w[..., 1] >> 3)
-        s1 = _rotr(w[..., 14], 17) ^ _rotr(w[..., 14], 19) ^ (w[..., 14] >> 10)
-        nxt = w[..., 0] + s0 + w[..., 9] + s1
-        return jnp.concatenate([w[..., 1:], nxt[..., None]], axis=-1), w[..., 0]
-
-    # words 0..63: first 16 from the block, rest from the rolling schedule
-    _, w_all = lax.scan(sched, block, None, length=64)
-    # w_all: (64, ...) — word t of the schedule
-
-    def round_step(st, wk):
-        w_t, k_t = wk
-        a, b, c, d, e, f, g, h = [st[..., i] for i in range(8)]
-        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + S1 + ch + k_t + w_t
-        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = S0 + maj
-        return jnp.stack(
-            [t1 + t2, a, b, c, d + t1, e, f, g], axis=-1
-        ), None
-
-    out, _ = lax.scan(round_step, state, (w_all, jnp.asarray(_K)))
-    return state + out
-
-
-def _bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
-    """(..., 4k) uint8 big-endian → (..., k) uint32."""
-    k = b.shape[-1] // 4
-    w = b.reshape(b.shape[:-1] + (k, 4)).astype(jnp.uint32)
-    return (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
-
-
-def _words_to_bytes(w: jnp.ndarray) -> jnp.ndarray:
-    out = jnp.stack(
-        [(w >> 24) & 0xFF, (w >> 16) & 0xFF, (w >> 8) & 0xFF, w & 0xFF],
-        axis=-1,
-    ).astype(jnp.uint8)
-    return out.reshape(w.shape[:-1] + (w.shape[-1] * 4,))
-
-
-@functools.partial(jax.jit, static_argnames=("msg_len",))
-def _sha256_fixed(data: jnp.ndarray, msg_len: int) -> jnp.ndarray:
-    """data (..., msg_len) uint8 → (..., 32) uint8 digests."""
-    pad_total = (-(msg_len + 9)) % 64 + 9
-    n_blocks = (msg_len + pad_total) // 64
-    batch = data.shape[:-1]
-    pad = jnp.zeros(batch + (pad_total,), jnp.uint8)
-    pad = pad.at[..., 0].set(0x80)
-    bitlen = msg_len * 8
-    lenb = jnp.asarray(
-        [(bitlen >> (8 * i)) & 0xFF for i in range(7, -1, -1)], jnp.uint8
-    )
-    pad = pad.at[..., -8:].set(jnp.broadcast_to(lenb, batch + (8,)))
-    full = jnp.concatenate([data, pad], axis=-1)
-    words = _bytes_to_words(full)  # (..., 16·n_blocks)
-    state = jnp.broadcast_to(jnp.asarray(_H0), batch + (8,))
-    for i in range(n_blocks):
-        state = _compress(state, words[..., 16 * i : 16 * (i + 1)])
-    return _words_to_bytes(state)
+__all__ = [
+    "_H0", "_K", "_rotr", "_bytes_to_words", "_compress",
+    "_sha256_fixed", "_words_to_bytes", "sha256",
+]
 
 
 def sha256(data: jnp.ndarray) -> jnp.ndarray:

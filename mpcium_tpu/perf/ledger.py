@@ -40,6 +40,12 @@ _CONTEXT_KEYS = (
     "ed25519_batch", "dkg_batch", "reshare_batch", "gg18_ot_mta_batch",
     "gg18_ot_mta_host_s", "gg18_ot_mta_device_s",
     "gg18_ot_mta_overlap_ratio", "gg18_ot_mta_chunks",
+    # bench_ot_host.py --device: host-vs-device hash-suite crossover
+    "m_ots", "threads", "cores",
+    "ot_host_stage_s", "ot_device_stage_s", "ot_device_stage_speedup",
+    "ot_host_prg_s", "ot_device_prg_s",
+    "ot_host_transpose_s", "ot_device_transpose_s",
+    "ot_host_pads_s", "ot_device_pads_s",
 )
 
 
@@ -91,6 +97,31 @@ def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
             rec["context"][k] = v
     if isinstance(parsed.get("mta"), str):
         rec["context"]["mta"] = parsed["mta"]
+    sweep = parsed.get("b_sweep")
+    if isinstance(sweep, dict):
+        ctx_sweep = {}
+        for bsz, entry in sorted(sweep.items()):
+            if isinstance(entry, (int, float)) and not isinstance(entry, bool):
+                ctx_sweep[bsz] = float(entry)
+                rec["metrics"][f"b_sweep_{bsz}_sigs_per_sec"] = float(entry)
+            elif isinstance(entry, dict) and entry.get("dnf"):
+                # the structured DNF shape bench.py records:
+                # {"dnf": true, "reason": "..."} — degraded context, never
+                # a metric
+                ctx_sweep[bsz] = {"dnf": True}
+                rec["notes"].append(
+                    f"b_sweep B={bsz} DNF: "
+                    f"{entry.get('reason') or 'no reason recorded'}"
+                )
+            else:
+                # anything else (legacy bare strings) is flagged verbatim
+                # rather than sniffed for substrings
+                ctx_sweep[bsz] = {"dnf": True}
+                rec["notes"].append(
+                    f"b_sweep B={bsz} unstructured entry "
+                    f"(pre-structured-DNF artifact): {entry!r}"
+                )
+        rec["context"]["b_sweep"] = ctx_sweep
     if isinstance(parsed.get("phase_s"), dict) and parsed["phase_s"]:
         if "no_spans" in parsed["phase_s"]:
             rec["notes"].append("no spans recorded (watchdog/DNF run)")

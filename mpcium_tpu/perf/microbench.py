@@ -12,10 +12,18 @@ layers a PR can silently slow down without touching a kernel:
   the real ``_TimingWheel`` (PR 5's one-thread timer core).
 - ``span_overhead``: mpctrace span open/close cost with tracing armed
   (PR 8's promise that observability stays cheap).
+- ``sha512_block``: host SHA-512 throughput (the hashlib fallback the
+  Ed25519 challenge hashing keeps for ragged batches).
+- ``prg_expand_device`` / ``ot_transpose_device``: warm-dispatch cost
+  of the ops.hash_suite device kernels the OT-MtA extension rides
+  (ISSUE 11) — compile happens once in the warmup call, so the samples
+  measure dispatch + execute, which is what a regression would slow.
 
-No jax import anywhere: perfcheck must run in <30 s on a bare CPU
-host. Samples use best-of-k inner reps to shave scheduler noise off
-the floor; the statistics in statcheck absorb what remains.
+No TOP-LEVEL jax import: perfcheck must run in <30 s on a bare CPU
+host, so the device rows import jax lazily inside the bench body and
+use deliberately small shapes. Samples use best-of-k inner reps to
+shave scheduler noise off the floor; the statistics in statcheck
+absorb what remains.
 """
 from __future__ import annotations
 
@@ -122,11 +130,65 @@ def span_overhead(samples: int = DEFAULT_SAMPLES, inner: int = 400) -> List[floa
             tracing.disable()
 
 
+def sha512_block(samples: int = DEFAULT_SAMPLES, kib: int = 96) -> List[float]:
+    """Host SHA-512 throughput — the hashlib fallback lane of the
+    Ed25519 challenge hashing (ragged message batches)."""
+    block = bytes(range(256)) * (kib * 4)  # kib KiB of fixed bytes
+
+    def body() -> None:
+        hashlib.sha512(block).digest()
+
+    return _timed_samples(body, samples)
+
+
+def prg_expand_device(samples: int = DEFAULT_SAMPLES) -> List[float]:
+    """Warm dispatch of the device IKNP PRG expansion (hash_suite):
+    KAPPA=128 seeds × 8 blocks. The warmup call inside _timed_samples
+    pays the one-time compile; samples measure dispatch + execute."""
+    import numpy as np
+
+    from ..ops import hash_suite as hs
+
+    seeds = np.frombuffer(
+        hashlib.sha256(b"perfcheck-prg-seeds").digest() * (128 * 32 // 32),
+        np.uint8,
+    ).reshape(128, 32)
+    prefix = b"perfcheck-prg|v1"
+
+    def body() -> None:
+        hs.prg_expand_device(prefix, seeds, 8).block_until_ready()
+
+    return _timed_samples(body, samples)
+
+
+def ot_transpose_device(samples: int = DEFAULT_SAMPLES) -> List[float]:
+    """Warm dispatch of the device packed bit-transpose (hash_suite):
+    (128, 512) packed bytes → (4096, 16), the per-chunk OT shape at
+    B=16 lanes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import hash_suite as hs
+
+    rng = random.Random(0x0707)
+    packed = jnp.asarray(np.frombuffer(
+        bytes(rng.getrandbits(8) for _ in range(128 * 512)), np.uint8
+    ).reshape(128, 512))
+
+    def body() -> None:
+        hs.ot_transpose_device(packed).block_until_ready()
+
+    return _timed_samples(body, samples)
+
+
 ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
     "field_mulmod": field_mulmod,
     "sha256_block": sha256_block,
+    "sha512_block": sha512_block,
     "wheel_latency": wheel_latency,
     "span_overhead": span_overhead,
+    "prg_expand_device": prg_expand_device,
+    "ot_transpose_device": ot_transpose_device,
 }
 
 

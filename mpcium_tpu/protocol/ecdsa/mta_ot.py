@@ -71,6 +71,7 @@ from ...core import bignum as bn
 from ...core import hostmath as hm
 from ...core import secp256k1_jax as sp
 from ...core.bignum import P256
+from ...ops import hash_suite as hs
 from ...utils import tracing
 
 KAPPA = 128  # IKNP width / computational security parameter
@@ -121,6 +122,17 @@ def resolve_chunks(B: int, chunks: Optional[int] = None) -> int:
     while B % chunks:
         chunks -= 1
     return chunks
+
+
+def device_path_enabled() -> bool:
+    """MPCIUM_OT_DEVICE gates ``run_multi``'s fused on-device extension
+    (default ON): PRG expansion, bit-matrix transpose, pad hashing and
+    payload masking all run as one jitted dispatch per chunk
+    (ops.hash_suite), and the host touches nothing but wire bytes. The
+    host/native path remains the wire-round implementation
+    (alice_round1 / bob_round2_multi / alice_round3_multi) and the
+    transcript oracle; set MPCIUM_OT_DEVICE=0 to force it in-process."""
+    return os.environ.get("MPCIUM_OT_DEVICE", "1") != "0"
 
 
 def _hash_rows(prefix: bytes, rows: np.ndarray) -> np.ndarray:
@@ -315,6 +327,55 @@ def _bits_256(a: jnp.ndarray) -> jnp.ndarray:
     return bn.limbs_to_bits(a, P256, NBITS)
 
 
+@jax.jit
+def _ot_chunk_device(
+    k0, k1, kD, delta_mask, delta_packed, prg_prefix, pad_prefixes,
+    r_bits_c, r_packed_c, m0s, m1s, blk_off, m_off,
+):
+    """One pipeline chunk of the extension, fused on device: PRG-expand
+    all three seed matrices, assemble U and Q, transpose both packed
+    matrices, derive every payload set's pads, mask the payloads and
+    recover Alice's selections — byte-for-byte the host three-round
+    composition, with only wire bytes ever leaving the device.
+
+    Shapes (Bc lanes per chunk, Mc = Bc·NBITS OTs, S payload sets):
+    seeds (κ, 32); delta_mask (κ, 1) uint8 0x00/0xFF; delta_packed
+    (κ/8,); prg_prefix / pad_prefixes traced uint8 ((P,), (S, P2) — the
+    tags embed the extension counter, so static args would recompile
+    every invocation); r_bits_c (Mc,); r_packed_c (Mc/8,); m0s/m1s
+    (S, Bc, NBITS, 32); blk_off/m_off traced uint32 (the chunk's PRG
+    block / global OT index origin). → (alphas (S, Bc, n), U (κ, Bc·32),
+    y0s, y1s (S, Mc, 32))."""
+    Bc = r_packed_c.shape[0] // 32
+    Mc = r_bits_c.shape[0]
+    t0 = hs.prg_expand_core(k0, prg_prefix, Bc, blk_off)
+    t1 = hs.prg_expand_core(k1, prg_prefix, Bc, blk_off)
+    tD = hs.prg_expand_core(kD, prg_prefix, Bc, blk_off)
+    U = t0 ^ t1 ^ r_packed_c[None, :]
+    Q = tD ^ (U & delta_mask)  # fold U into the Δ=1 rows only
+    rows_a = hs.ot_transpose_core(t0)  # (Mc, κ/8)
+    rows_b = hs.ot_transpose_core(Q)
+    idx_le = hs.le32_bytes(
+        jnp.asarray(m_off, jnp.uint32) + jnp.arange(Mc, dtype=jnp.uint32)
+    )
+    sel_bits = r_bits_c.astype(bool)[:, None]
+    alphas, y0s, y1s = [], [], []
+    for s in range(pad_prefixes.shape[0]):
+        pref = pad_prefixes[s]
+        pad_a = hs.pad_hash_core(pref, rows_a, idx_le)
+        pad0 = hs.pad_hash_core(pref, rows_b, idx_le)
+        pad1 = hs.pad_hash_core(pref, rows_b ^ delta_packed[None, :], idx_le)
+        y0 = pad0 ^ m0s[s].reshape(Mc, 32)
+        y1 = pad1 ^ m1s[s].reshape(Mc, 32)
+        sel = jnp.where(sel_bits, y1, y0) ^ pad_a
+        alphas.append(
+            _sum_mod_q(_reduce_bytes(sel.reshape(Bc, NBITS, 32)))
+        )
+        y0s.append(y0)
+        y1s.append(y1)
+    return jnp.stack(alphas), U, jnp.stack(y0s), jnp.stack(y1s)
+
+
 # ---------------------------------------------------------------------------
 # the per-ordered-pair MtA instance
 # ---------------------------------------------------------------------------
@@ -395,6 +456,23 @@ class OTMtALeg:
         return [
             b"mpcium-ot-pad|" + tag + b"|s%d" % s for s in range(n_sets)
         ]
+
+    def _device_state(self) -> Dict[str, jnp.ndarray]:
+        """Base-OT key material as device arrays, uploaded once per leg
+        and reused by every device-path extension."""
+        st = getattr(self, "_dev_state", None)
+        if st is None:
+            st = {
+                "k0": jnp.asarray(self.k0),
+                "k1": jnp.asarray(self.k1),
+                "kD": jnp.asarray(self.keysD),
+                "delta_mask": jnp.asarray(
+                    (self.delta.astype(np.uint8) * np.uint8(0xFF))[:, None]
+                ),
+                "delta_packed": jnp.asarray(self.delta_packed),
+            }
+            self._dev_state = st
+        return st
 
     # -- chunk-granular extension stages (host side) -------------------------
     #
@@ -559,26 +637,38 @@ class OTMtALeg:
         b_list,
         chunks: Optional[int] = None,
         timings: Optional[Dict[str, float]] = None,
+        transcript: Optional[list] = None,
     ):
         """Both roles locally, several Bob scalars against one ``a``
         (ONE extension): → [(alpha_s, beta_s)] with
         alpha_s + beta_s ≡ a·b_s (mod q) per lane.
 
-        Pipelined: the batch is split into ``chunks`` sub-batches
-        (resolve_chunks — MPCIUM_OT_CHUNKS / auto). All device-side
-        payload math (z reduction, the 2^i·b ladder, m0/m1 assembly,
-        β sums) is dispatched asynchronously up front, and every
-        chunk's host-side extension work (PRG expansion, transpose,
-        pad hashing) is enqueued on the background worker BEFORE any
-        device array is blocked on — so while the device computes
-        chunk i, the host is already expanding chunk i+1. Chunking
-        changes scheduling only: per-lane results and transcripts are
-        bit-identical to the serial three-round composition for every
-        chunk count.
+        Two implementations, bit-identical transcripts (the z draw
+        order, PRG block schedule and pad domains are shared, so the
+        wire bytes cannot differ — tests/test_mta_ot_device.py):
+
+        * **Device** (default; ``device_path_enabled``): the whole
+          extension — PRG, transpose, pads, masking, selection — fuses
+          into one jitted dispatch per chunk (``_ot_chunk_device``).
+          The host stage degenerates to wire-byte packing; nothing is
+          pulled off device in the hot loop.
+        * **Host/native** (MPCIUM_OT_DEVICE=0, or > 10 payload sets):
+          pipelined double-buffer. The batch is split into ``chunks``
+          sub-batches (resolve_chunks — MPCIUM_OT_CHUNKS / auto), all
+          device-side payload math is dispatched asynchronously up
+          front, and every chunk's host extension work (PRG expansion,
+          transpose, pad hashing) is enqueued on the background worker
+          BEFORE any device array is blocked on. Chunking changes
+          scheduling only: results and transcripts are bit-identical
+          to the serial three-round composition for every chunk count.
 
         ``timings`` (optional dict) accumulates host_s (worker busy
         time), device_wait_s / host_wait_s (main-thread blocking) and
-        total_s — the bench's overlap instrumentation."""
+        total_s — the bench's overlap instrumentation; the device path
+        reports total_s only (there is no host stage to time).
+        ``transcript`` (optional list; device path only) receives one
+        {"U", "y0", "y1"} dict of host arrays per chunk — the wire
+        bytes, for oracle comparison in tests."""
         from ... import native
 
         b_list = tuple(b_list)
@@ -596,17 +686,27 @@ class OTMtALeg:
         t_total0 = time.perf_counter()
         t_span0 = tracing.now_ns()
 
-        r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)
-        r_packed = _pack(r_bits)
         # z randomness: one serial-order draw per payload set — the
         # exact stream positions of the unchunked path (bit-exactness
-        # under a deterministic rng) and the only rng use, so the
-        # worker thread never touches the rng.
+        # under a deterministic rng) and the only rng use, so neither
+        # the worker thread nor the device path perturbs the stream.
         z_raw = [
             np.frombuffer(self.rng.token_bytes(M * 32), np.uint8)
             .reshape(B, NBITS, 32)
             for _ in b_list
         ]
+
+        # > 10 sets would ragged-stack the pad prefixes (`|s10` is one
+        # byte wider); no engine path comes close, but fall back loudly
+        # rather than mis-shape.
+        if device_path_enabled() and len(b_list) <= 10:
+            return self._run_multi_device(
+                a, b_list, K, tag, z_raw, timings, transcript,
+                t_total0, t_span0,
+            )
+
+        r_bits = np.asarray(_bits_256(a)).astype(np.uint8).reshape(M)  # mpcflow: host-ok — host/native fallback path (MPCIUM_OT_DEVICE=0): choice bits drive the host IKNP stage; the default device path never pulls them
+        r_packed = _pack(r_bits)
 
         Bc = B // K
         Mc = Bc * NBITS
@@ -656,8 +756,8 @@ class OTMtALeg:
             for s in range(len(b_list)):
                 m0_d, m1_d, beta_d = dev[c][s]
                 t_w = time.perf_counter()
-                m0 = np.asarray(m0_d).reshape(Mc, 32)
-                m1 = np.asarray(m1_d).reshape(Mc, 32)
+                m0 = np.asarray(m0_d).reshape(Mc, 32)  # mpcflow: host-ok — host/native fallback path (MPCIUM_OT_DEVICE=0): payloads meet the host-derived pads here; the default device path masks on device
+                m1 = np.asarray(m1_d).reshape(Mc, 32)  # mpcflow: host-ok — host/native fallback path (MPCIUM_OT_DEVICE=0): payloads meet the host-derived pads here; the default device path masks on device
                 device_wait += time.perf_counter() - t_w
                 pad0, pad1 = padsB[s]
                 y0 = native.xor_rows(pad0, m0)
@@ -700,5 +800,81 @@ class OTMtALeg:
             host_wait_s=round(host_wait, 6),
             device_wait_s=round(device_wait, 6),
             chunks=K, sets=len(b_list),
+        )
+        return list(zip(alphas, betas))
+
+    def _run_multi_device(
+        self, a, b_list, K, tag, z_raw, timings, transcript,
+        t_total0, t_span0,
+    ):
+        """Device extension driver (see run_multi): per chunk, dispatch
+        the payload math then the fused `_ot_chunk_device` kernel. The
+        host never sees the extension matrices, pads or choice bits —
+        only the optional ``transcript`` capture (tests) and the final
+        shares cross the wire boundary. Chunk boundaries are the same
+        PRG-block / OT-index origins as the host path, so the K=1/2/4
+        transcripts are all identical to the serial composition."""
+        B = a.shape[0]
+        M = B * NBITS
+        Bc = B // K
+        Mc = Bc * NBITS
+        n_sets = len(b_list)
+        dev = self._device_state()
+        prg_prefix = jnp.asarray(
+            np.frombuffer(b"mpcium-ot-prg|" + tag, np.uint8)
+        )
+        pad_prefixes = jnp.asarray(
+            np.frombuffer(
+                b"".join(self._pad_prefixes(tag, n_sets)), np.uint8
+            ).reshape(n_sets, -1)
+        )
+        r_bits_d = _bits_256(a).astype(jnp.uint8).reshape(M)
+        r_packed_d = hs.pack_bits_core(r_bits_d)
+
+        alpha_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
+        beta_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
+        for c in range(K):
+            sl = slice(c * Bc, (c + 1) * Bc)
+            m0s, m1s = [], []
+            for s, b_s in enumerate(b_list):
+                z_red = _reduce_bytes(jnp.asarray(z_raw[s][sl]))
+                m1s.append(_m1_payloads(z_red, _pow2_ladder(b_s[sl])))
+                m0s.append(bn.limbs_to_bytes_le(z_red, P256, 32))
+                beta_pieces[s].append(_neg_sum_mod_q(z_red))
+            alphas_c, U_c, y0s_c, y1s_c = _ot_chunk_device(
+                dev["k0"], dev["k1"], dev["kD"], dev["delta_mask"],
+                dev["delta_packed"], prg_prefix, pad_prefixes,
+                r_bits_d[c * Mc:(c + 1) * Mc],
+                r_packed_d[c * Bc * 32:(c + 1) * Bc * 32],
+                jnp.stack(m0s), jnp.stack(m1s),
+                jnp.uint32(c * Bc), jnp.uint32(c * Mc),
+            )
+            for s in range(n_sets):
+                alpha_pieces[s].append(alphas_c[s])
+            if transcript is not None:
+                transcript.append({
+                    "U": np.asarray(U_c),  # mpcflow: host-ok — transcript-oracle capture (tests only; None in production)
+                    "y0": [np.asarray(y0s_c[s]) for s in range(n_sets)],  # mpcflow: host-ok — transcript-oracle capture (tests only; None in production)
+                    "y1": [np.asarray(y1s_c[s]) for s in range(n_sets)],  # mpcflow: host-ok — transcript-oracle capture (tests only; None in production)
+                })
+
+        alphas = [
+            p[0] if K == 1 else jnp.concatenate(p, axis=0)
+            for p in alpha_pieces
+        ]
+        betas = [
+            p[0] if K == 1 else jnp.concatenate(p, axis=0)
+            for p in beta_pieces
+        ]
+        if timings is not None:
+            timings["total_s"] = (
+                timings.get("total_s", 0.0)
+                + time.perf_counter() - t_total0
+            )
+        tracing.emit(
+            "phase:ot_extension", t_span0, tracing.now_ns(),
+            node="engine", tid=f"ot:B{B}",
+            host_wait_s=0.0, device_wait_s=0.0,
+            chunks=K, sets=n_sets, device=True,
         )
         return list(zip(alphas, betas))

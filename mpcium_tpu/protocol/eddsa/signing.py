@@ -20,6 +20,7 @@ the reference (tss-lib eddsa/signing).
 """
 from __future__ import annotations
 
+import os
 from typing import List, Sequence
 
 from ...core import hostmath as hm
@@ -29,6 +30,24 @@ from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg
 R1 = "eddsa/sign/1"
 R2 = "eddsa/sign/2"
 R3 = "eddsa/sign/3"
+
+
+def _challenge_int(R_bytes: bytes, A_bytes: bytes, message: bytes) -> int:
+    """RFC 8032 challenge H(R ‖ A ‖ M) as a little-endian integer.
+
+    Default: host hashlib (hm.sha512_int_le) — one digest per session is
+    control-plane. MPCIUM_EDDSA_DEVICE_HASH_SESSION=1 routes it through
+    the device SHA-512 kernel instead (ops.hash_suite.sha512_bytes;
+    byte-identical — useful for validating the kernel against the
+    per-session oracle on a new platform; the batch engine's fused path
+    is engine/eddsa_batch.challenge_device)."""
+    if os.environ.get("MPCIUM_EDDSA_DEVICE_HASH_SESSION", "0") == "1":
+        from ...ops.hash_suite import sha512_bytes
+
+        return int.from_bytes(
+            sha512_bytes(R_bytes + A_bytes + message), "little"
+        )
+    return hm.sha512_int_le(R_bytes, A_bytes, message)
 
 
 class EDDSASigningParty(PartyBase):
@@ -139,7 +158,7 @@ class EDDSASigningParty(PartyBase):
             R = hm.ed_add(R, R_points[pid])
         self._R_bytes = hm.ed_compress(R)
 
-        c = hm.sha512_int_le(
+        c = _challenge_int(
             self._R_bytes, self.share.public_key, self.message
         ) % hm.ED_L
         lam = hm.lagrange_coeff(

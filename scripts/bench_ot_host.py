@@ -16,7 +16,17 @@ wall-clock >= 2x at 4 threads. On a single-core container (the
 dev-loop host: nproc == 1) the ratio is honestly ~1.0x — the JSON
 carries "cores" so the driver can tell the two apart.
 
+--device adds the host-vs-device hash-suite A/B (ISSUE 11): each
+extension sub-stage — PRG expansion, packed bit-transpose, pad
+hashing — timed on the host/native path and on the ops.hash_suite
+device kernels (warm, post-compile), outputs asserted bit-identical,
+and the comparison emitted in the same JSON record under
+ot_host_*/ot_device_* keys so the perf ledger (PERF_history.jsonl)
+tracks the crossover. JAX is only imported in this mode; the default
+host-only run stays JAX-free.
+
 Usage: python scripts/bench_ot_host.py [--m 1048576] [--threads 4]
+                                       [--device]
 """
 from __future__ import annotations
 
@@ -73,11 +83,115 @@ def _timed(n_runs, *args):
     return best, digest
 
 
+def _best_of(n_runs, fn):
+    best = float("inf")
+    out = None
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def _device_ab(seeds3, M, tag, n_runs):
+    """Per-sub-stage host vs device A/B: PRG, transpose, pads. Each
+    device kernel is compiled once (warmup) and timed warm with
+    block_until_ready; outputs are asserted bit-identical to the host
+    path before any timing is reported."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpcium_tpu.ops import hash_suite as hs
+
+    k0 = seeds3[0]
+    n_bytes = M // 8
+    nblk = -(-n_bytes // 32)
+    prg_prefix = b"mpcium-ot-prg|" + tag
+    pad_prefix = b"bench-pad|" + tag + b"|s0"
+
+    # --- PRG expansion: (KAPPA, 32) seeds -> (KAPPA, M/8) keystream
+    host_prg_s, t0_host = _best_of(
+        n_runs, lambda: mta_ot._prg(k0, n_bytes, tag)
+    )
+    dev_prg = hs.prg_expand_device(prg_prefix, k0, nblk)  # compile
+    dev_prg.block_until_ready()
+    device_prg_s, dev_prg = _best_of(
+        n_runs,
+        lambda: hs.prg_expand_device(prg_prefix, k0, nblk)
+        .block_until_ready(),
+    )
+    assert np.array_equal(
+        np.asarray(dev_prg)[:, :n_bytes], t0_host
+    ), "device PRG diverged from host PRG"
+
+    # --- packed bit-transpose: (KAPPA, M/8) -> (M, KAPPA/8)
+    def host_transpose():
+        rows = native.ot_transpose(t0_host) if native.available() else None
+        if rows is None:
+            rows = mta_ot._pack(mta_ot._unpack(t0_host, M).T)
+        return rows
+
+    host_transpose_s, rows_host = _best_of(n_runs, host_transpose)
+    t0_dev = jnp.asarray(t0_host)
+    hs.ot_transpose_device(t0_dev).block_until_ready()  # compile
+    device_transpose_s, rows_dev = _best_of(
+        n_runs,
+        lambda: hs.ot_transpose_device(t0_dev).block_until_ready(),
+    )
+    assert np.array_equal(
+        np.asarray(rows_dev), rows_host
+    ), "device transpose diverged from host transpose"
+
+    # --- pad hashing: H(prefix || row || le32(j)) per OT -> (M, 32)
+    idx = np.arange(M, dtype=np.uint32).view(np.uint8).reshape(M, 4)
+
+    def host_pads():
+        return mta_ot._hash_rows(
+            pad_prefix, np.concatenate([rows_host, idx], axis=1)
+        )
+
+    host_pads_s, pads_host = _best_of(n_runs, host_pads)
+    pref_dev = jnp.asarray(np.frombuffer(pad_prefix, np.uint8))
+    rows_dev = jnp.asarray(rows_host)
+    m_off = jnp.uint32(0)
+    hs.pad_hash_device(pref_dev, rows_dev, m_off).block_until_ready()
+    device_pads_s, pads_dev = _best_of(
+        n_runs,
+        lambda: hs.pad_hash_device(pref_dev, rows_dev, m_off)
+        .block_until_ready(),
+    )
+    assert np.array_equal(
+        np.asarray(pads_dev), pads_host
+    ), "device pads diverged from host pads"
+
+    host_total = host_prg_s + host_transpose_s + host_pads_s
+    dev_total = device_prg_s + device_transpose_s + device_pads_s
+    return {
+        "device_platform": jax.devices()[0].platform,
+        "ot_host_prg_s": round(host_prg_s, 4),
+        "ot_device_prg_s": round(device_prg_s, 4),
+        "ot_host_transpose_s": round(host_transpose_s, 4),
+        "ot_device_transpose_s": round(device_transpose_s, 4),
+        "ot_host_pads_s": round(host_pads_s, 4),
+        "ot_device_pads_s": round(device_pads_s, 4),
+        "ot_host_stage_s": round(host_total, 4),
+        "ot_device_stage_s": round(dev_total, 4),
+        "ot_device_stage_speedup": (
+            round(host_total / dev_total, 3) if dev_total > 0 else 0.0
+        ),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, default=1 << 20, help="OT count M")
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument(
+        "--device", action="store_true",
+        help="also A/B each sub-stage against the ops.hash_suite device "
+             "kernels (imports JAX)",
+    )
     args = ap.parse_args()
 
     rng = np.random.default_rng(42)
@@ -103,7 +217,7 @@ def main() -> None:
         d_1[1], d_n[1]
     ), "thread count changed the transcript"
 
-    print(json.dumps({
+    record = {
         "metric": "ot_host_extension_stage_speedup",
         "value": round(t_1 / t_n, 3) if t_n > 0 else 0.0,
         "unit": "x (1 thread / %d threads wall)" % args.threads,
@@ -113,7 +227,10 @@ def main() -> None:
         "native": native.available(),
         "stage_s_1thread": round(t_1, 3),
         "stage_s_nthread": round(t_n, 3),
-    }))
+    }
+    if args.device:
+        record.update(_device_ab(seeds3, args.m, b"ab", args.runs))
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

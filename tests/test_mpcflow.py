@@ -3,11 +3,12 @@
 This is ``make check``'s mpcflow stage as a test: any non-baselined
 taint/residency finding fails, any stale baseline entry fails, the
 committed HOST_TRANSFER_BUDGET.json must match the sweep exactly, and
-the sweep must stay fast enough to live in tier-1. The budget's two
-known host walls (the IKNP OT-extension host stage and the Ed25519 host
-SHA-512 round-trip) are asserted as *tracked* debt — if an edit makes
-them intentional or removes them, this test forces the bookkeeping
-(baseline + ROADMAP) to move in the same commit.
+the sweep must stay fast enough to live in tier-1. The budget's
+remaining tracked debt — the two Paillier host-modexp sites, after the
+device hash suite retired the IKNP OT host stage and the Ed25519 host
+SHA-512 round-trip — is asserted exactly: if an edit makes a site
+intentional, removes it, or adds new debt, this test forces the
+bookkeeping (baseline + ROADMAP) to move in the same commit.
 """
 from __future__ import annotations
 
@@ -85,16 +86,30 @@ def _tracked(budget, phase):
 
 def test_budget_tracks_the_known_host_walls():
     budget = json.loads(BUDGET_PATH.read_text())
-    # wall 1: IKNP OT-extension host stage (ROADMAP item 2 deletes it)
-    mta = _tracked(budget, "ecdsa.mta_ot")
-    assert (
-        "mpcium_tpu/protocol/ecdsa/mta_ot.py",
-        "OTMtALeg.run_multi",
-        "_bits_256",
-    ) in mta
-    # wall 2: Ed25519 host SHA-512 round-trip (device SHA-512 deletes it)
-    eddsa = _tracked(budget, "eddsa.sign")
-    assert {d for (_p, _s, d) in eddsa} >= {"R_comp", "R_sum"}
+    # The device hash suite retired the IKNP OT host stage and the
+    # Ed25519 host SHA-512 round-trip: those phases carry NO tracked
+    # debt (the fallback paths are annotated intentional).
+    assert _tracked(budget, "ecdsa.mta_ot") == set()
+    assert _tracked(budget, "eddsa.sign") == set()
+    # The only remaining wall: Paillier host modexp in the range-proof
+    # batcher (ROADMAP item 2's last leg — device multi-word modmul).
+    assert _tracked(budget, "ecdsa.sign") == {
+        (
+            "mpcium_tpu/engine/gg18_batch.py",
+            "_host_pow_single",
+            "x_limbs",
+        ),
+        (
+            "mpcium_tpu/engine/gg18_batch.py",
+            "_host_pow_batch",
+            "x_limbs",
+        ),
+    }
+    # and nothing anywhere else: tracked debt is exactly 2
+    total = sum(
+        ph["tracked"] for ph in budget["phases"].values()
+    )
+    assert total == 2, f"tracked debt drifted: {total} != 2"
 
 
 def test_tracked_debt_is_baselined_with_an_exit():

@@ -1,0 +1,121 @@
+"""Device OT extension path (ISSUE 11): MPCIUM_OT_DEVICE=1 (the
+default) fuses PRG expansion, bit-transpose, pad hashing and payload
+masking into one device dispatch per chunk. The contract that lets it
+ship without bumping OT_WIRE_VERSION: transcripts and shares are
+BIT-identical to the host/native path — which stays the wire-round
+implementation and the oracle — for every chunk count.
+
+Reuses the synthetic-base-OT fixtures of test_mta_ot_pipeline (tier-1,
+CPU)."""
+import numpy as np
+import pytest
+
+from mpcium_tpu.protocol.ecdsa import mta_ot
+from test_mta_ot_pipeline import B, DetRng, _ints, _limbs, synth_leg
+
+Q = mta_ot.Q
+
+
+@pytest.fixture(scope="module")
+def fixed_inputs():
+    r = DetRng(11)
+    a = [r.randbelow(Q) for _ in range(B)]
+    g = [r.randbelow(Q) for _ in range(B)]
+    w = [r.randbelow(Q) for _ in range(B)]
+    a[1] = 0
+    w[0] = Q - 1
+    return a, g, w
+
+
+@pytest.fixture(scope="module")
+def wire_oracle(fixed_inputs):
+    """The serial three-round wire composition — U and y0/y1 exactly as
+    they would cross the network — plus the resulting shares."""
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(21)
+    msg_a = leg.alice_round1(_limbs(a_ints), 0)
+    msgs_b, betas = leg.bob_round2_multi(
+        (_limbs(g_ints), _limbs(w_ints)), msg_a, 0
+    )
+    alphas = leg.alice_round3_multi(msgs_b)
+    shares = [
+        (np.asarray(al), np.asarray(be)) for al, be in zip(alphas, betas)
+    ]
+    for (al, be), b_ints in zip(shares, (g_ints, w_ints)):
+        ai, bi = _ints(al), _ints(be)
+        for i in range(B):
+            assert (ai[i] + bi[i]) % Q == a_ints[i] * b_ints[i] % Q, i
+    return msg_a, msgs_b, shares
+
+
+@pytest.mark.parametrize("K", [1, 2, 4])
+def test_device_transcript_bit_identical_to_host(
+    K, monkeypatch, fixed_inputs, wire_oracle
+):
+    """The SECURITY.md claim, mechanically: the device path changes
+    where pads are derived, never the bytes on the wire. Per chunk
+    count, the captured U / y0 / y1 wire tensors must concatenate to
+    exactly the serial composition's messages, and the shares must
+    match."""
+    monkeypatch.setenv("MPCIUM_OT_DEVICE", "1")
+    msg_a, msgs_b, shares = wire_oracle
+    a_ints, g_ints, w_ints = fixed_inputs
+    leg = synth_leg(21)
+    transcript = []
+    out = leg.run_multi(
+        _limbs(a_ints), (_limbs(g_ints), _limbs(w_ints)),
+        chunks=K, transcript=transcript,
+    )
+    assert len(transcript) == K
+    U = np.concatenate([t["U"] for t in transcript], axis=1)
+    assert np.array_equal(U, msg_a["U"]), f"K={K}: U diverged"
+    for s in range(2):
+        y0 = np.concatenate([t["y0"][s] for t in transcript], axis=0)
+        y1 = np.concatenate([t["y1"][s] for t in transcript], axis=0)
+        assert np.array_equal(y0, msgs_b[s]["y0"]), f"K={K} set {s}: y0"
+        assert np.array_equal(y1, msgs_b[s]["y1"]), f"K={K} set {s}: y1"
+        assert np.array_equal(np.asarray(out[s][0]), shares[s][0])
+        assert np.array_equal(np.asarray(out[s][1]), shares[s][1])
+
+
+@pytest.mark.parametrize("K", [1, 2, 4])
+def test_host_and_device_shares_identical(K, monkeypatch, fixed_inputs):
+    """run_multi itself, flipped both ways on the same rng stream."""
+    a_ints, g_ints, w_ints = fixed_inputs
+    outs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("MPCIUM_OT_DEVICE", flag)
+        leg = synth_leg(22)
+        outs[flag] = leg.run_multi(
+            _limbs(a_ints), (_limbs(g_ints), _limbs(w_ints)), chunks=K
+        )
+    for s in range(2):
+        for j in range(2):
+            assert np.array_equal(
+                np.asarray(outs["0"][s][j]), np.asarray(outs["1"][s][j])
+            ), (K, s, "alpha" if j == 0 else "beta")
+
+
+def test_device_timings_report_no_host_stage(monkeypatch):
+    """The device path's whole point: timings carry total_s but no host
+    extension time (gg18_batch divides by host_s only when > 0)."""
+    monkeypatch.setenv("MPCIUM_OT_DEVICE", "1")
+    leg = synth_leg(23)
+    timings = {}
+    leg.run_multi(_limbs([3, 5, 7, 9]), (_limbs([2, 4, 6, 8]),),
+                  chunks=2, timings=timings)
+    assert timings["total_s"] > 0.0
+    assert timings.get("host_s", 0.0) == 0.0
+
+
+def test_extension_counter_advances_on_device_path(monkeypatch):
+    """Consecutive device extensions must land in disjoint PRF ranges
+    (the stateful-IKNP invariant): same inputs, different transcripts."""
+    monkeypatch.setenv("MPCIUM_OT_DEVICE", "1")
+    leg = synth_leg(24)
+    a, b = _limbs([3, 5, 7, 9]), _limbs([2, 4, 6, 8])
+    t1, t2 = [], []
+    leg.run_multi(a, (b,), chunks=1, transcript=t1)
+    leg.run_multi(a, (b,), chunks=1, transcript=t2)
+    assert leg.ctr == 2
+    assert not np.array_equal(t1[0]["U"], t2[0]["U"])

@@ -136,6 +136,7 @@ def test_numpy_fallback_bit_identical(
 
     monkeypatch.setattr(native, "_lib", None)
     monkeypatch.setattr(native, "_tried", True)
+    monkeypatch.setenv("MPCIUM_OT_DEVICE", "0")  # pin the host path: this test is about the numpy fallback
     assert not native.available()
     a_ints, g_ints, w_ints = fixed_inputs
     leg = synth_leg(1)
@@ -153,6 +154,7 @@ def test_single_thread_pin_bit_identical(
     """MPCIUM_NATIVE_THREADS=1 (deterministic single-thread mode) —
     same transcripts, same shares."""
     monkeypatch.setenv("MPCIUM_NATIVE_THREADS", "1")
+    monkeypatch.setenv("MPCIUM_OT_DEVICE", "0")  # the thread knob only exists on the host path
     a_ints, g_ints, w_ints = fixed_inputs
     leg = synth_leg(1)
     out = leg.run_multi(

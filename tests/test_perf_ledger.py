@@ -44,6 +44,40 @@ def test_dnf_rounds_are_degraded_with_notes():
         assert not rec["metrics"]
 
 
+def test_b_sweep_entries_ingest_without_string_sniffing():
+    """bench.py's sweep contract: numeric entries become metrics, the
+    structured DNF shape {"dnf": true, "reason": ...} becomes a note,
+    and anything else (legacy bare strings) is flagged verbatim — the
+    ledger never parses prose to classify an entry."""
+    rec = ledger._base_record("BENCH_synthetic.json", "bench")
+    ledger._normalize_bench_parsed(rec, {
+        "metric": "m", "value": 1.0, "platform": "tpu",
+        "b_sweep": {
+            "1024": 39.7,
+            "8192": {"dnf": True, "reason": "watchdog fired"},
+            "16384": "DNF: legacy prose entry",
+        },
+    })
+    assert rec["metrics"]["b_sweep_1024_sigs_per_sec"] == 39.7
+    assert rec["context"]["b_sweep"]["1024"] == 39.7
+    assert rec["context"]["b_sweep"]["8192"] == {"dnf": True}
+    assert any(
+        "b_sweep B=8192 DNF: watchdog fired" in n for n in rec["notes"]
+    )
+    assert any("unstructured" in n and "16384" in n for n in rec["notes"])
+    assert "b_sweep_8192_sigs_per_sec" not in rec["metrics"]
+    assert "b_sweep_16384_sigs_per_sec" not in rec["metrics"]
+
+
+def test_committed_ot_artifact_b_sweep_is_structured():
+    """BENCH_TPU_OT.json's B=8192 DNF was migrated to the structured
+    shape: it must normalize to a DNF note, not an unstructured flag."""
+    rec = ledger.normalize(os.path.join(ROOT, "BENCH_TPU_OT.json"))
+    assert rec["metrics"]["b_sweep_4096_sigs_per_sec"] == 72.091
+    assert any("b_sweep B=8192 DNF" in n for n in rec["notes"])
+    assert not any("unstructured" in n for n in rec["notes"])
+
+
 def test_cpu_fallback_rounds_never_look_like_chip_records():
     r5 = ledger.normalize(os.path.join(ROOT, "BENCH_r05.json"))
     chip = ledger.normalize(os.path.join(ROOT, "BENCH_TPU_LATEST.json"))
