@@ -2,15 +2,15 @@
 
 PY ?= python
 
-.PHONY: install lint check trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check shapecheck trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
 
 # static analysis (STATIC_ANALYSIS.md): ruff and mypy run when installed
 # (the hermetic CI image ships neither — their defect classes are covered
-# natively by mpclint MPL6xx); mpclint + mpcflow always run and are the
-# gate — check_all parses the AST once and feeds both analyzers.
+# natively by mpclint MPL6xx); mpclint + mpcflow + mpcshape always run
+# and are the gate — check_all parses the AST once and feeds all three.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null || command -v ruff >/dev/null 2>&1; then \
 	  echo "== ruff"; ruff check mpcium_tpu/ scripts/ tests/ || exit $$?; \
@@ -18,16 +18,24 @@ lint:
 	@if $(PY) -c "import mypy" 2>/dev/null; then \
 	  echo "== mypy"; $(PY) -m mypy mpcium_tpu/wire.py mpcium_tpu/config.py mpcium_tpu/utils/ || exit $$?; \
 	else echo "== mypy not installed — skipped"; fi
-	@echo "== mpclint + mpcflow"; $(PY) scripts/check_all.py
+	@echo "== mpclint + mpcflow + mpcshape"; $(PY) scripts/check_all.py
 
-# the one-pass static gate alone (mpclint + mpcflow + budget drift,
-# shared AST parse) — what CI calls between edit and test; the trace
-# gate rides along (--no-sweep: the sweep just ran), and perfcheck
-# (statistical micro-bench regression gate, <30 s, CPU-safe) closes it
+# the one-pass static gate alone (mpclint + mpcflow + mpcshape +
+# budget/surface drift, shared AST parse) — what CI calls between edit
+# and test; the trace gate rides along (--no-sweep: the sweep just
+# ran), and perfcheck (statistical micro-bench regression gate, <30 s,
+# CPU-safe) closes it
 check:
 	$(PY) scripts/check_all.py
 	$(PY) scripts/trace_check.py --no-sweep
 	$(PY) scripts/perfcheck.py
+
+# compile-surface gate alone (STATIC_ANALYSIS.md "Compile surface"):
+# MPS9xx rules + COMPILE_SURFACE.json drift. Run
+# scripts/mpcshape_surface.py (no --check) after an intentional
+# signature change, review the diff, commit the JSON.
+shapecheck:
+	$(PY) scripts/mpcshape_surface.py --check
 
 # statistical perf-regression gate alone (PERFORMANCE.md "perf
 # observatory"): micro-benches vs the committed PERF_baseline_micro.json

@@ -598,12 +598,20 @@ def _run_b_sweep(record: dict) -> None:
         "MPCIUM_BENCH_SWEEP_TIMEOUT_S",
         os.environ.get("MPCIUM_BENCH_WATCHDOG_S", "2700"),
     ))
+    from mpcium_tpu.engine.buckets import bucket_b
+
     sweep: dict = {}
     for tok in spec.split(","):
         tok = tok.strip()
         if not tok:
             continue
-        sweep[tok] = _b_sweep_entry(int(tok), timeout_s)
+        # snap to the pow-2 bucket grid (engine/buckets.py): an off-grid
+        # sweep point would time a compile signature no production path
+        # requests — the scheduler only ever emits floor_bucket chunks
+        bsz = bucket_b(int(tok))
+        if str(bsz) in sweep:
+            continue
+        sweep[str(bsz)] = _b_sweep_entry(bsz, timeout_s)
         # partial progress beats an empty field if a later size wedges
         record["b_sweep"] = dict(sweep)
         _STATE["record"] = dict(record)

@@ -32,6 +32,10 @@ _HOLDS_RE = re.compile(r"#\s*mpclint:\s*holds=([A-Za-z0-9_]+)")
 #   pub = digest(sk)  # mpcflow: declassified — commitment, not the secret
 _HOST_OK_RE = re.compile(r"#\s*mpcflow:\s*host-ok(?:\s*[—-]\s*(.*))?$")
 _DECLASSIFY_RE = re.compile(r"#\s*mpcflow:\s*declassified\b")
+# mpcshape (analysis/shape/) annotation, indexed here for the same
+# shared-parse reason:
+#   self.B = len(shares)  # mpcshape: unbounded-ok — manifests are pow-2
+_SHAPE_OK_RE = re.compile(r"#\s*mpcshape:\s*unbounded-ok(?:\s*[—-]\s*(.*))?$")
 
 
 @dataclass(frozen=True)
@@ -76,6 +80,8 @@ class ParsedFile:
         # lines whose assignments declassify secret taint
         self.host_ok: Dict[int, str] = {}
         self.declassified: Set[int] = set()
+        # mpcshape: line -> reason a shape dim is allowed to stay unbounded
+        self.shape_ok: Dict[int, str] = {}
         for i, text in enumerate(self.lines, start=1):
             m = _DISABLE_RE.search(text)
             if m:
@@ -95,6 +101,9 @@ class ParsedFile:
             m = _HOST_OK_RE.search(text)
             if m:
                 self.host_ok[i] = (m.group(1) or "").strip()
+            m = _SHAPE_OK_RE.search(text)
+            if m:
+                self.shape_ok[i] = (m.group(1) or "").strip()
             if _DECLASSIFY_RE.search(text):
                 self.declassified.add(i)
         # extra secret names declared via `# mpclint: secret` annotations:

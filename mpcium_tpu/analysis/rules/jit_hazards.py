@@ -7,10 +7,12 @@ batch shape. Both are invisible in tests (CPU jit hides the cost) and
 expensive on the accelerator, so they are linted instead.
 
 MPL401  host-side numpy / .item() / scalar coercion of a traced value
-        inside a ``@jax.jit`` body. Trace-time constants (e.g. a domain
-        tag built with np.frombuffer from a bytes literal) are legal but
-        must be baselined with a justification saying so — the baseline
-        is where "this is trace-time" claims get reviewed.
+        inside a ``@jax.jit`` body. np.* calls whose arguments reference
+        no traced parameter (e.g. a domain tag built with np.frombuffer
+        from a bytes literal and a loop index) are trace-time constant
+        folding, not host syncs, and are NOT flagged here — the
+        large-constant executable-bloat class they can cause belongs to
+        MPS903 (analysis/shape), which sizes them.
 MPL402  Python ``if``/``while`` on a non-static parameter inside a jit
         body — shape/dtype/ndim attribute tests are exempt (static under
         tracing); everything else either crashes or retraces.
@@ -105,7 +107,19 @@ class HostSyncInJit(Rule):
                 name = dotted_name(node.func)
                 offense = ""
                 if name and name.startswith(_HOST_ROOTS):
-                    offense = name
+                    arg_ids = {
+                        n.id
+                        for a in (
+                            list(node.args)
+                            + [kw.value for kw in node.keywords]
+                        )
+                        for n in ast.walk(a)
+                        if isinstance(n, ast.Name)
+                    }
+                    # np.* over literals/loop indices only runs at trace
+                    # time (constant folding) — MPS903 owns that class
+                    if arg_ids & traced:
+                        offense = name
                 elif (
                     isinstance(node.func, ast.Attribute)
                     and node.func.attr in _SYNC_METHODS

@@ -1,0 +1,87 @@
+"""mpcshape: static compile-surface analysis for mpcium_tpu.
+
+The compile wall (ROADMAP item 4: 802–1,401 s of XLA recompile per
+shape) has a measurement half (PR 9's compile ledger) and needs a cure
+— shape-bucketed AOT pre-warming — whose precondition is a trustworthy
+answer to *"what is the complete set of compile signatures this
+codebase can ever request?"*. mpcshape answers it statically, on the
+same ParsedFile set / symbol table / call graph mpcflow uses:
+
+- **jits.py** enumerates every jit entry point (decorated defs,
+  ``name = jax.jit(fn)`` assignments, vmap wrappers) with their static
+  and donated parameters;
+- **sigs.py** extracts each engine's compile-signature template from
+  its ``compile_watch.begin`` site and classifies every signature
+  dimension constant / knob / bucketed / unbounded by provenance;
+- **rules.py** enforces MPS901–905 (unbounded-dim-on-serving-path,
+  retrace-per-call, large closure constants, dtype instability,
+  vmap/donation misuse);
+- **surface.py** renders the committed, drift-gated
+  ``COMPILE_SURFACE.json`` and provides the runtime matcher
+  ``perf/compile_watch`` uses to stamp ledger entries ``predicted``.
+
+Findings reuse mpclint's Finding/fingerprint/baseline machinery, so the
+shared .mpclint-baseline.json and fail-closed-both-ways gate apply
+unchanged (scope ``MPS``).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import LintResult, ParsedFile, parse_project
+from ..flow.callgraph import CallGraph
+from ..flow.residency import PHASE_ENTRY_POINTS
+from ..flow.symbols import ProjectIndex
+from .jits import JitEntry, JitInventory
+from .rules import RULE_IDS, run_rules
+from .sigs import BeginSite, collect_begin_sites
+from .surface import SURFACE_BASENAME, build_surface, render, shape_predicted
+
+__all__ = [
+    "BeginSite", "JitEntry", "JitInventory", "RULE_IDS",
+    "SURFACE_BASENAME", "build_surface", "render", "run_shape",
+    "run_shape_parsed", "shape_predicted",
+]
+
+
+def _default_serving_roots() -> Set[str]:
+    return {fid for fids in PHASE_ENTRY_POINTS.values() for fid in fids}
+
+
+def run_shape_parsed(
+    files: Sequence[ParsedFile],
+    parse_errors: Sequence[str] = (),
+    serving_roots: Optional[Iterable[str]] = None,
+) -> Tuple[LintResult, Dict[str, object]]:
+    """Run the compile-surface analysis over already-parsed files.
+    Returns (LintResult with MPS findings, the surface dict)."""
+    index = ProjectIndex(files)
+    graph = CallGraph(index)
+    inventory = JitInventory(index)
+    sites = collect_begin_sites(index)
+    roots = set(
+        serving_roots if serving_roots is not None
+        else _default_serving_roots()
+    )
+    reachable = graph.reachable_from(roots)
+    for s in sites:
+        s.serving = s.fid in reachable
+    findings = run_rules(index, graph, inventory, sites)
+    result = LintResult()
+    result.files_scanned = len(files)
+    result.parse_errors = list(parse_errors)
+    result.findings = findings
+    return result, build_surface(sites, inventory.entries)
+
+
+def run_shape(
+    paths: Optional[Sequence[Path]] = None,
+    root: Optional[Path] = None,
+) -> Tuple[LintResult, Dict[str, object]]:
+    """Parse + analyze (standalone entry point; the combined gate goes
+    through scripts/check_all.py to share the parse with mpclint)."""
+    root = root or Path(__file__).resolve().parents[3]
+    paths = list(paths) if paths else [root / "mpcium_tpu"]
+    files, errors = parse_project(paths, root=root)
+    return run_shape_parsed(files, parse_errors=errors)

@@ -1,0 +1,206 @@
+"""The jit entry-point inventory.
+
+Every way this codebase creates a compiled callable is enumerated here,
+because each one is a row in COMPILE_SURFACE.json and a potential
+retrace hazard for the MPS9xx rules:
+
+- **decorated defs** — ``@jax.jit``, ``@jit``, ``@jax.jit(...)`` and
+  ``@functools.partial(jax.jit, static_argnames=...)`` (the dominant
+  form in engine/ and ops/);
+- **wrapped assignments** — ``name = jax.jit(fn, static_argnums=...)``
+  at module or class scope (``ot_transpose_device`` in ops/hash_suite);
+- **vmap wrappers** — ``name = jax.vmap(fn, in_axes=...)`` (a vmap of a
+  jitted core is still one compile per outer shape).
+
+Static parameters are resolved to *names* (argnums are mapped through
+the wrapped function's parameter list) so call-site checks can match
+keyword and positional arguments alike. ``donate`` carries
+``donate_argnums``-declared parameter names for MPS905.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..core import ParsedFile
+from ..flow.symbols import FuncInfo, ProjectIndex, _dotted
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pjit", "pjit")
+_VMAP_NAMES = ("jax.vmap", "vmap")
+
+
+class JitEntry:
+    """One compiled entry point (a def or a wrapping assignment)."""
+
+    __slots__ = (
+        "path", "symbol", "kind", "params", "static", "donate",
+        "target_fid", "line", "node",
+    )
+
+    def __init__(self, path: str, symbol: str, kind: str,
+                 params: Sequence[str], static: Set[str],
+                 donate: Set[str], target_fid: Optional[str],
+                 line: int, node: ast.AST):
+        self.path = path
+        self.symbol = symbol  # dotted name callers use
+        self.kind = kind  # "jit" | "wrapped" | "vmap"
+        self.params = list(params)
+        self.static = set(static)
+        self.donate = set(donate)
+        self.target_fid = target_fid  # underlying def when resolvable
+        self.line = line
+        self.node = node
+
+    @property
+    def name(self) -> str:
+        return self.symbol.rsplit(".", 1)[-1]
+
+    def row(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "symbol": self.symbol,
+            "kind": self.kind,
+            "params": self.params,
+            "static": sorted(self.static),
+        }
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    return [
+        c.value
+        for c in ast.walk(node)
+        if isinstance(c, ast.Constant) and isinstance(c.value, str)
+    ]
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    return [
+        c.value
+        for c in ast.walk(node)
+        if isinstance(c, ast.Constant) and isinstance(c.value, int)
+        and not isinstance(c.value, bool)
+    ]
+
+
+def _static_from_keywords(
+    keywords: Sequence[ast.keyword], params: Sequence[str]
+) -> Set[str]:
+    static: Set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            static.update(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+def _donate_from_keywords(
+    keywords: Sequence[ast.keyword], params: Sequence[str]
+) -> Set[str]:
+    donate: Set[str] = set()
+    for kw in keywords:
+        if kw.arg == "donate_argnames":
+            donate.update(_const_strs(kw.value))
+        elif kw.arg == "donate_argnums":
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    donate.add(params[i])
+    return donate
+
+
+def _decorator_jit(fi: FuncInfo) -> Optional[JitEntry]:
+    """A JitEntry for a jit-decorated def, else None."""
+    for dec in fi.node.decorator_list:
+        name = _dotted(dec)
+        if name in _JIT_NAMES:
+            return JitEntry(fi.pf.rel, fi.qualname, "jit", fi.params,
+                            set(), set(), fi.fid, fi.node.lineno, fi.node)
+        if isinstance(dec, ast.Call):
+            cname = _dotted(dec.func)
+            inner = _dotted(dec.args[0]) if dec.args else ""
+            if cname in _JIT_NAMES or (
+                cname.endswith("partial") and inner in _JIT_NAMES
+            ):
+                return JitEntry(
+                    fi.pf.rel, fi.qualname, "jit", fi.params,
+                    _static_from_keywords(dec.keywords, fi.params),
+                    _donate_from_keywords(dec.keywords, fi.params),
+                    fi.fid, fi.node.lineno, fi.node,
+                )
+    return None
+
+
+class JitInventory:
+    """Every jit entry in the project, with call-site lookup tables."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.entries: List[JitEntry] = []
+        self.by_fid: Dict[str, JitEntry] = {}  # decorated-def fid -> entry
+        # wrapper-assignment name -> entries (unique-name fallback)
+        self.by_name: Dict[str, List[JitEntry]] = {}
+        for fi in index.functions.values():
+            e = _decorator_jit(fi)
+            if e is not None:
+                self.entries.append(e)
+                self.by_fid[fi.fid] = e
+        for pf in index.files:
+            self._scan_assignments(pf)
+        self.entries.sort(key=lambda e: (e.path, e.symbol))
+
+    def _scan_assignments(self, pf: ParsedFile) -> None:
+        for node in ast.walk(pf.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            cname = _dotted(node.value.func)
+            if cname in _JIT_NAMES:
+                kind = "wrapped"
+            elif cname in _VMAP_NAMES:
+                kind = "vmap"
+            else:
+                continue
+            assigned = node.targets[0].id
+            scope = pf.symbol_of(node)
+            symbol = f"{scope}.{assigned}".lstrip(".")
+            target_fid = None
+            params: List[str] = []
+            if node.value.args:
+                tgt = self.index.resolve_name_target(
+                    pf.rel, _dotted(node.value.args[0])
+                )
+                if tgt in self.index.functions:
+                    target_fid = tgt
+                    params = self.index.functions[tgt].params
+            entry = JitEntry(
+                pf.rel, symbol, kind, params,
+                _static_from_keywords(node.value.keywords, params),
+                _donate_from_keywords(node.value.keywords, params),
+                target_fid, node.lineno, node,
+            )
+            self.entries.append(entry)
+            self.by_name.setdefault(assigned, []).append(entry)
+
+    # -- call-site resolution ------------------------------------------------
+
+    def resolve_call(self, graph, fi: FuncInfo,
+                     call: ast.Call) -> Optional[JitEntry]:
+        """The JitEntry a call site compiles through, if any: decorated
+        defs resolve through the call graph; wrapper assignments by
+        (unique) assigned name."""
+        fid = graph.resolve_callee(fi, call.func)
+        if fid is not None and fid in self.by_fid:
+            return self.by_fid[fid]
+        name = _dotted(call.func).rsplit(".", 1)[-1]
+        cands = self.by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        # several modules define the same wrapper name: same-file wins
+        same = [e for e in cands if e.path == fi.pf.rel]
+        return same[0] if len(same) == 1 else None

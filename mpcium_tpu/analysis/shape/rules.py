@@ -1,0 +1,433 @@
+"""MPS9xx — compile-surface rules.
+
+MPS901  unbounded shape polymorphism on a serving path: a signature
+        dimension at a ``compile_watch.begin`` site classifies as
+        *unbounded* with no ``# mpcshape: unbounded-ok`` annotation,
+        and the site is reachable from a protocol-phase entry point.
+        Every distinct value of that dim is a fresh XLA compile an
+        operator pays at serving time — bucket it (engine/buckets.py)
+        or annotate the contract that bounds it.
+MPS902  retrace-per-call hazards at jit call sites: a loop variable
+        flowing into a static parameter (one compile per iteration), or
+        ``len(<param>)`` fed to a static parameter (one compile per
+        input size) — the class of bug PR 10 hand-fixed in prg_expand
+        by making the block offset traced.
+MPS903  a jit body closing over a module-level np./jnp. array of
+        provably >= 4096 elements: the array is constant-folded into
+        every jaxpr that references it, bloating each compiled
+        executable (pass it as an argument instead).
+MPS904  dtype instability: the same traced jit parameter receives
+        explicitly different dtypes across call sites — each distinct
+        dtype is a separate compile of the same kernel.
+MPS905  vmap-axis/donation misuse: non-constant ``in_axes``/
+        ``out_axes`` (a fresh axes spec is a fresh jaxpr), or a donated
+        argument read after the donating call (donation invalidates the
+        buffer).
+
+All findings carry mpclint's line-number-free fingerprints and flow
+through the shared baseline; ``# mpclint: disable=MPS90x`` suppressions
+work as for every other rule family.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding
+from ..flow.callgraph import CallGraph
+from ..flow.symbols import FuncInfo, ProjectIndex, _dotted
+from .jits import JitInventory
+from .sigs import BeginSite
+
+MPS903_MIN_ELEMENTS = 4096
+
+_VMAP_NAMES = ("jax.vmap", "vmap")
+_DTYPE_CTORS = {
+    "uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32",
+    "int64", "float16", "float32", "float64", "bfloat16",
+}
+
+
+def _finding(rule: str, fi: FuncInfo, line: int, key: str,
+             message: str) -> Finding:
+    return Finding(rule=rule, path=fi.pf.rel, line=line,
+                   symbol=fi.qualname, key=key, message=message)
+
+
+# -- MPS901 ------------------------------------------------------------------
+
+
+def check_unbounded_serving(sites: Sequence[BeginSite],
+                            index: ProjectIndex) -> Iterator[Finding]:
+    for site in sites:
+        if not site.serving:
+            continue
+        fi = index.functions[site.fid]
+        for d in site.dims:
+            if d.cls != "unbounded" or d.annotated:
+                continue
+            yield _finding(
+                "MPS901", fi, site.line, f"{site.engine}:{d.name}",
+                f"signature dim {d.name!r} of engine {site.engine!r} is "
+                f"unbounded ({d.source}) on a serving path — every value "
+                f"is a fresh XLA compile; bucket it (engine/buckets.py) "
+                f"or annotate '# mpcshape: unbounded-ok — reason'",
+            )
+
+
+# -- MPS902 ------------------------------------------------------------------
+
+
+def _static_args_at_call(entry, call: ast.Call):
+    """(param, expr) pairs for arguments landing on static parameters."""
+    out = []
+    params = entry.params
+    for i, a in enumerate(call.args):
+        if i < len(params) and params[i] in entry.static:
+            out.append((params[i], a))
+    for kw in call.keywords:
+        if kw.arg in entry.static:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def _loop_vars(fi: FuncInfo) -> Dict[int, Set[str]]:
+    """For-loop target names by the loop's body span (approx: all names
+    bound by any enclosing For in the function)."""
+    vars_: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.For):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    vars_.add(n.id)
+    return vars_  # type: ignore[return-value]
+
+
+def _call_inside_loop(fi: FuncInfo, call: ast.Call) -> bool:
+    for node in ast.walk(fi.node):
+        if isinstance(node, (ast.For, ast.While)):
+            for sub in ast.walk(node):
+                if sub is call:
+                    return True
+    return False
+
+
+def check_retrace_per_call(index: ProjectIndex, graph: CallGraph,
+                           inventory: JitInventory) -> Iterator[Finding]:
+    for fid, fi in sorted(index.functions.items()):
+        loop_vars = _loop_vars(fi)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = inventory.resolve_call(graph, fi, node)
+            if entry is None or not entry.static:
+                continue
+            for param, expr in _static_args_at_call(entry, node):
+                names = {
+                    n.id for n in ast.walk(expr) if isinstance(n, ast.Name)
+                }
+                hot = sorted(names & loop_vars)
+                if hot and _call_inside_loop(fi, node):
+                    yield _finding(
+                        "MPS902", fi, node.lineno,
+                        f"{entry.name}:{param}:loop",
+                        f"loop variable {hot[0]!r} reaches static param "
+                        f"{param!r} of jit entry {entry.name!r} — one "
+                        f"recompile per iteration; make it traced or "
+                        f"hoist the variation out of the static arg",
+                    )
+                    continue
+                if (
+                    isinstance(expr, ast.Call)
+                    and _dotted(expr.func) == "len"
+                    and expr.args
+                    and isinstance(expr.args[0], ast.Name)
+                    and expr.args[0].id in fi.params
+                ):
+                    yield _finding(
+                        "MPS902", fi, node.lineno,
+                        f"{entry.name}:{param}:len",
+                        f"len({expr.args[0].id}) feeds static param "
+                        f"{param!r} of jit entry {entry.name!r} — one "
+                        f"recompile per input size; bucket the length "
+                        f"(engine/buckets.py) or make the dim traced",
+                    )
+
+
+# -- MPS903 ------------------------------------------------------------------
+
+
+def _literal_elements(call: ast.Call) -> Optional[int]:
+    """Element count of an np./jnp. constructor call when provable."""
+    dotted = _dotted(call.func)
+    if not (dotted.startswith(("np.", "numpy.", "jnp.", "jax.numpy."))):
+        return None
+    leaf = dotted.rsplit(".", 1)[-1]
+
+    def count(node) -> Optional[int]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            total = 0
+            for e in node.elts:
+                c = count(e)
+                if c is None:
+                    return None
+                total += c
+            return total
+        if isinstance(node, ast.Constant):
+            return 1
+        return None
+
+    if leaf in ("array", "asarray") and call.args:
+        return count(call.args[0])
+    if leaf == "arange" and call.args:
+        ints = [
+            a.value for a in call.args
+            if isinstance(a, ast.Constant) and isinstance(a.value, int)
+        ]
+        if len(ints) == len(call.args) and ints:
+            if len(ints) == 1:
+                return max(0, ints[0])
+            step = ints[2] if len(ints) > 2 else 1
+            return max(0, (ints[1] - ints[0]) // (step or 1))
+        return None
+    if leaf in ("zeros", "ones", "full", "empty") and call.args:
+        shape = call.args[0]
+        if isinstance(shape, ast.Constant) and isinstance(shape.value, int):
+            return shape.value
+        if isinstance(shape, (ast.Tuple, ast.List)):
+            total = 1
+            for e in shape.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None
+                total *= e.value
+            return total
+    return None
+
+
+def _module_array_sizes(pf) -> Dict[str, Tuple[int, int]]:
+    """module-level name -> (elements, lineno) for provably-large arrays."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in pf.tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        n = _literal_elements(node.value)
+        if n is not None and n >= MPS903_MIN_ELEMENTS:
+            out[node.targets[0].id] = (n, node.lineno)
+    return out
+
+
+def check_large_closure_constants(
+    index: ProjectIndex, inventory: JitInventory
+) -> Iterator[Finding]:
+    sizes_by_rel: Dict[str, Dict[str, Tuple[int, int]]] = {}
+    for entry in inventory.entries:
+        fi = index.functions.get(entry.target_fid or "")
+        if fi is None:
+            continue
+        if fi.pf.rel not in sizes_by_rel:
+            sizes_by_rel[fi.pf.rel] = _module_array_sizes(fi.pf)
+        sizes = sizes_by_rel[fi.pf.rel]
+        if not sizes:
+            continue
+        bound = set(fi.params)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            bound.add(n.id)
+        seen: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            if node.id in bound or node.id in seen or node.id not in sizes:
+                continue
+            seen.add(node.id)
+            n_el, _ln = sizes[node.id]
+            yield _finding(
+                "MPS903", fi, node.lineno, f"{entry.name}:{node.id}",
+                f"jit body {entry.name!r} closes over module-level array "
+                f"{node.id!r} (~{n_el} elements) — constant-folded into "
+                f"every jaxpr referencing it; pass it as an argument",
+            )
+
+
+# -- MPS904 ------------------------------------------------------------------
+
+
+def _dtype_token(expr: ast.AST) -> Optional[str]:
+    """An explicit dtype evident at a call-site argument, if any."""
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, bool):
+            return None
+        if isinstance(expr.value, int):
+            return "weak_int"
+        if isinstance(expr.value, float):
+            return "weak_float"
+        return None
+    if not isinstance(expr, ast.Call):
+        return None
+    dotted = _dotted(expr.func)
+    leaf = dotted.rsplit(".", 1)[-1]
+    if leaf in _DTYPE_CTORS and dotted.startswith(
+        ("np.", "numpy.", "jnp.", "jax.numpy.")
+    ):
+        return leaf
+    if leaf == "astype" and expr.args:
+        t = _dotted(expr.args[0]).rsplit(".", 1)[-1]
+        return t if t in _DTYPE_CTORS else None
+    for kw in expr.keywords:
+        if kw.arg == "dtype":
+            t = _dotted(kw.value).rsplit(".", 1)[-1]
+            if t in _DTYPE_CTORS:
+                return t
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                return kw.value.value
+    return None
+
+
+def check_dtype_instability(index: ProjectIndex, graph: CallGraph,
+                            inventory: JitInventory) -> Iterator[Finding]:
+    per_param: Dict[Tuple[int, str], Dict[str, Tuple[FuncInfo, int]]] = {}
+    entries: Dict[int, object] = {}
+    for fid, fi in sorted(index.functions.items()):
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = inventory.resolve_call(graph, fi, node)
+            if entry is None:
+                continue
+            entries[id(entry)] = entry
+            params = entry.params
+            pairs = [
+                (params[i], a) for i, a in enumerate(node.args)
+                if i < len(params) and params[i] not in entry.static
+            ] + [
+                (kw.arg, kw.value) for kw in node.keywords
+                if kw.arg and kw.arg not in entry.static
+            ]
+            for pname, expr in pairs:
+                tok = _dtype_token(expr)
+                if tok is None:
+                    continue
+                per_param.setdefault(
+                    (id(entry), pname), {}
+                ).setdefault(tok, (fi, node.lineno))
+    for (eid, pname), toks in sorted(
+        per_param.items(), key=lambda kv: (entries[kv[0][0]].symbol, kv[0][1])
+    ):
+        if len(toks) < 2:
+            continue
+        entry = entries[eid]
+        fi, line = sorted(toks.values(), key=lambda v: (v[0].pf.rel, v[1]))[0]
+        yield _finding(
+            "MPS904", fi, line, f"{entry.name}:{pname}",
+            f"traced param {pname!r} of jit entry {entry.name!r} receives "
+            f"conflicting explicit dtypes across call sites "
+            f"({', '.join(sorted(toks))}) — each dtype is a separate "
+            f"compile; pin one dtype at the boundary",
+        )
+
+
+# -- MPS905 ------------------------------------------------------------------
+
+
+def _axes_static(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_axes_static(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return all(_axes_static(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _axes_static(node.operand)
+    return False
+
+
+def check_vmap_donation(index: ProjectIndex, graph: CallGraph,
+                        inventory: JitInventory) -> Iterator[Finding]:
+    for fid, fi in sorted(index.functions.items()):
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) in _VMAP_NAMES:
+                target = (
+                    _dotted(node.args[0]) if node.args else "?"
+                ) or "?"
+                for kw in node.keywords:
+                    if kw.arg in ("in_axes", "out_axes") and not _axes_static(
+                        kw.value
+                    ):
+                        yield _finding(
+                            "MPS905", fi, node.lineno,
+                            f"{target}:{kw.arg}",
+                            f"non-constant {kw.arg} on vmap of {target!r} "
+                            f"— every distinct axes spec traces a fresh "
+                            f"jaxpr; use literal axes",
+                        )
+            entry = inventory.resolve_call(graph, fi, node)
+            if entry is None or not entry.donate:
+                continue
+            params = entry.params
+            donated = [
+                (params[i], a) for i, a in enumerate(node.args)
+                if i < len(params) and params[i] in entry.donate
+                and isinstance(a, ast.Name)
+            ] + [
+                (kw.arg, kw.value) for kw in node.keywords
+                if kw.arg in entry.donate and isinstance(kw.value, ast.Name)
+            ]
+            for pname, name_node in donated:
+                for later in ast.walk(fi.node):
+                    if (
+                        isinstance(later, ast.Name)
+                        and isinstance(later.ctx, ast.Load)
+                        and later.id == name_node.id
+                        and later.lineno > node.lineno
+                    ):
+                        yield _finding(
+                            "MPS905", fi, later.lineno,
+                            f"{entry.name}:{pname}:donated-reuse",
+                            f"{name_node.id!r} is donated to jit entry "
+                            f"{entry.name!r} (param {pname!r}) but read "
+                            f"afterwards — donation invalidates the "
+                            f"buffer; drop the later read or the "
+                            f"donation",
+                        )
+                        break
+
+
+RULE_IDS = ("MPS901", "MPS902", "MPS903", "MPS904", "MPS905")
+
+
+def run_rules(index: ProjectIndex, graph: CallGraph,
+              inventory: JitInventory,
+              sites: Sequence[BeginSite]) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(check_unbounded_serving(sites, index))
+    findings.extend(check_retrace_per_call(index, graph, inventory))
+    findings.extend(check_large_closure_constants(index, inventory))
+    findings.extend(check_dtype_instability(index, graph, inventory))
+    findings.extend(check_vmap_donation(index, graph, inventory))
+    # central suppression + fingerprint dedupe (mirrors lint_parsed)
+    by_rel = {pf.rel: pf for pf in index.files}
+    out: List[Finding] = []
+    seen: Set[str] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.key)):
+        pf = by_rel.get(f.path)
+        if pf is not None and pf.is_suppressed(f.rule, f.line):
+            continue
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        out.append(f)
+    return out

@@ -1,0 +1,319 @@
+"""Compile-signature extraction from ``compile_watch.begin`` sites.
+
+The shape string an engine passes to ``compile_watch.begin(engine,
+f"B{B}|q{q}|...")`` IS its compile-signature key: one XLA compile exists
+per distinct value of that f-string. mpcshape parses the JoinedStr into
+a *template* (``"B{B}|q{q}"``) whose interpolated expressions are the
+signature dimensions, then classifies each dimension by provenance:
+
+- **constant** — statically a fixed value;
+- **knob** — an operator/config degree of freedom (quorum size,
+  key_type, mta impl, thresholds): finite by configuration. Dimension
+  *names* on the knob list classify as knobs regardless of provenance —
+  the name is the policy (``q`` is always a config-bounded quorum);
+- **bucketed** — provenance flows through ``engine/buckets.py``
+  (``floor_bucket``/``bucket_b``): value provably in the pow-2 set;
+- **unbounded** — request-varying with no bucketing on the path
+  (``len(shares)`` and friends). Allowed only with an explicit
+  ``# mpcshape: unbounded-ok — reason`` annotation on the begin line or
+  the provenance assignment line; un-annotated unbounded dims on a
+  serving-reachable site raise MPS901.
+
+Provenance follows local assignments (including tuple unpacking like
+``q, B = self.q, self.B``), ``self.X`` attributes into ``__init__``,
+env/config reads, and function parameters, depth-limited — anything it
+cannot prove stays unbounded, which is the fail-closed direction.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core import ParsedFile
+from ..flow.symbols import FuncInfo, ProjectIndex, _dotted
+
+# batch/session-sized names: classified by provenance, never by name
+BATCH_DIM_NAMES = {"B", "b", "batch", "bsz", "n_wallets", "n_sessions"}
+
+# config/operator degrees of freedom: finite by configuration; the name
+# alone classifies (quorums, thresholds, curve and impl selectors)
+KNOB_DIM_NAMES = {
+    "q", "q_old", "n", "t", "t_new", "tp1", "threshold", "key_type",
+    "mta_impl", "mta", "occ", "chunks", "nblk", "scheme",
+}
+
+_BUCKET_FNS = ("floor_bucket", "bucket_b")
+_ENV_READS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+
+
+class Dim:
+    __slots__ = ("name", "cls", "source", "value", "annotated", "reason")
+
+    def __init__(self, name: str, cls: str, source: str,
+                 value: Optional[object] = None,
+                 annotated: bool = False, reason: str = ""):
+        self.name = name
+        self.cls = cls  # constant | knob | bucketed | unbounded
+        self.source = source
+        self.value = value
+        self.annotated = annotated
+        self.reason = reason
+
+    def row(self) -> Dict[str, object]:
+        row: Dict[str, object] = {"class": self.cls, "source": self.source}
+        if self.cls == "constant" and self.value is not None:
+            row["value"] = self.value
+        if self.annotated:
+            row["annotated"] = True
+            row["reason"] = self.reason
+        return row
+
+
+class BeginSite:
+    """One ``compile_watch.begin`` call: an engine's signature template."""
+
+    __slots__ = ("engine", "template", "dims", "path", "symbol", "line",
+                 "fid", "serving")
+
+    def __init__(self, engine: str, template: str, dims: List[Dim],
+                 path: str, symbol: str, line: int, fid: str):
+        self.engine = engine
+        self.template = template
+        self.dims = dims
+        self.path = path
+        self.symbol = symbol
+        self.line = line
+        self.fid = fid
+        self.serving = False  # set by the runner from the call graph
+
+    @property
+    def finite(self) -> bool:
+        return all(
+            d.cls in ("constant", "knob", "bucketed") or d.annotated
+            for d in self.dims
+        )
+
+
+def _expr_text(e: ast.AST) -> str:
+    try:
+        return ast.unparse(e)
+    except Exception:  # noqa: BLE001 — display-only fallback
+        return type(e).__name__
+
+
+class _Provenance:
+    """Depth-limited definition-chasing for one begin site."""
+
+    def __init__(self, fi: FuncInfo, index: ProjectIndex):
+        self.fi = fi
+        self.index = index
+        # (pf, line) trail of visited assignments — annotation lookup
+        self.trail: List[Tuple[ParsedFile, int]] = []
+
+    def classify(self, e: ast.AST, fi: Optional[FuncInfo] = None,
+                 depth: int = 0) -> Tuple[str, str, Optional[object]]:
+        """(class, source, value) for one dim expression."""
+        fi = fi or self.fi
+        if depth > 6:
+            return "unbounded", "provenance depth limit", None
+        if isinstance(e, ast.Constant):
+            return "constant", "literal", e.value
+        if isinstance(e, ast.Name):
+            return self._classify_name(e.id, fi, depth)
+        if isinstance(e, ast.Attribute):
+            owner = e.value
+            if isinstance(owner, ast.Name) and owner.id in ("self", "cls"):
+                return self._classify_self_attr(e.attr, fi, depth)
+            return "unbounded", _expr_text(e), None
+        if isinstance(e, ast.Call):
+            dotted = _dotted(e.func)
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _BUCKET_FNS:
+                return "bucketed", f"{leaf}() (engine/buckets.py)", None
+            if dotted in _ENV_READS:
+                return "knob", f"env {_expr_text(e)}", None
+            if dotted == "len":
+                return "unbounded", f"len({_expr_text(e.args[0]) if e.args else '?'})", None
+            if dotted in ("int", "str"):
+                if e.args:
+                    return self.classify(e.args[0], fi, depth + 1)
+            return "unbounded", _expr_text(e), None
+        if isinstance(e, ast.BinOp):
+            lc, ls, lv = self.classify(e.left, fi, depth + 1)
+            rc, rs, rv = self.classify(e.right, fi, depth + 1)
+            order = {"unbounded": 3, "bucketed": 2, "knob": 1, "constant": 0}
+            cls = max((lc, rc), key=lambda c: order[c])
+            return cls, f"{ls} ∘ {rs}", None
+        return "unbounded", _expr_text(e), None
+
+    def _classify_name(self, name: str, fi: FuncInfo, depth: int):
+        rhs = self._local_def(name, fi)
+        if rhs is not None:
+            node, value = rhs
+            self.trail.append((fi.pf, node.lineno))
+            return self.classify(value, fi, depth + 1)
+        if name in fi.params:
+            cls = "knob" if name in KNOB_DIM_NAMES else "unbounded"
+            return cls, f"param {name}", None
+        # module-level constant?
+        mod_rhs = self._module_def(name, fi.pf)
+        if mod_rhs is not None:
+            self.trail.append((fi.pf, mod_rhs.lineno))
+            return self.classify(mod_rhs.value, fi, depth + 1)
+        return "unbounded", f"unresolved name {name}", None
+
+    def _classify_self_attr(self, attr: str, fi: FuncInfo, depth: int):
+        # assignment inside the current function body first (self.x = ...)
+        rhs = self._self_def(attr, fi)
+        if rhs is None and fi.cls:
+            init_fid = self.index.lookup_method(fi.cls, "__init__")
+            init = self.index.functions.get(init_fid) if init_fid else None
+            if init is not None and init is not fi:
+                rhs = self._self_def(attr, init)
+                if rhs is not None:
+                    node, value = rhs
+                    self.trail.append((init.pf, node.lineno))
+                    return self.classify(value, init, depth + 1)
+        if rhs is not None:
+            node, value = rhs
+            self.trail.append((fi.pf, node.lineno))
+            return self.classify(value, fi, depth + 1)
+        return "unbounded", f"unresolved attribute self.{attr}", None
+
+    def _local_def(self, name: str, fi: FuncInfo):
+        """Last ``name = ...`` in fi's body (tuple unpacking unpacked)."""
+        found = None
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            value = self._match_target(node.targets[0], node.value,
+                                       lambda t: isinstance(t, ast.Name)
+                                       and t.id == name)
+            if value is not None:
+                found = (node, value)
+        return found
+
+    def _self_def(self, attr: str, fi: FuncInfo):
+        found = None
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+
+            def hit(t, attr=attr):
+                return (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr == attr
+                )
+
+            value = self._match_target(node.targets[0], node.value, hit)
+            if value is not None:
+                found = (node, value)
+        return found
+
+    def _match_target(self, target, value, pred):
+        """The RHS sub-expression assigned to the target ``pred`` picks —
+        positional through parallel tuple assignment."""
+        if pred(target):
+            return value
+        if isinstance(target, (ast.Tuple, ast.List)) and isinstance(
+            value, (ast.Tuple, ast.List)
+        ) and len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                got = self._match_target(t, v, pred)
+                if got is not None:
+                    return got
+        return None
+
+    def _module_def(self, name: str, pf: ParsedFile):
+        for node in pf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+            ):
+                return node
+        return None
+
+
+def _dim_name(e: ast.AST, i: int) -> str:
+    if isinstance(e, ast.Name):
+        return e.id
+    if (
+        isinstance(e, ast.Attribute)
+        and isinstance(e.value, ast.Name)
+        and e.value.id in ("self", "cls")
+    ):
+        return e.attr
+    return f"expr{i}"
+
+
+def _annotation_reason(site_pf: ParsedFile, begin_line: int,
+                       trail: Sequence[Tuple[ParsedFile, int]],
+                       ) -> Optional[str]:
+    """The unbounded-ok reason covering this dim: the begin line (or the
+    line above it) or any provenance assignment line."""
+    for ln in (begin_line, begin_line - 1):
+        if ln in site_pf.shape_ok:
+            return site_pf.shape_ok[ln]
+    for pf, ln in trail:
+        for cand in (ln, ln - 1):
+            if cand in pf.shape_ok:
+                return pf.shape_ok[cand]
+    return None
+
+
+def collect_begin_sites(index: ProjectIndex) -> List[BeginSite]:
+    sites: List[BeginSite] = []
+    for fid, fi in sorted(index.functions.items()):
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) != "compile_watch.begin":
+                continue
+            if len(node.args) < 2:
+                continue
+            eng = node.args[0]
+            if not (isinstance(eng, ast.Constant)
+                    and isinstance(eng.value, str)):
+                continue
+            site = _parse_site(eng.value, node, fi, index)
+            sites.append(site)
+    return sites
+
+
+def _parse_site(engine: str, call: ast.Call, fi: FuncInfo,
+                index: ProjectIndex) -> BeginSite:
+    shape = call.args[1]
+    parts: List[str] = []
+    dims: List[Dim] = []
+    exprs: List[ast.AST] = []
+    if isinstance(shape, ast.Constant) and isinstance(shape.value, str):
+        parts.append(shape.value)
+    elif isinstance(shape, ast.JoinedStr):
+        for v in shape.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                exprs.append(v.value)
+                parts.append("{" + _dim_name(v.value, len(exprs) - 1) + "}")
+    else:
+        parts.append("{" + _expr_text(shape) + "}")
+        exprs.append(shape)
+    for i, e in enumerate(exprs):
+        name = _dim_name(e, i)
+        prov = _Provenance(fi, index)
+        cls, source, value = prov.classify(e)
+        if cls == "unbounded" and name in KNOB_DIM_NAMES:
+            cls, source = "knob", f"knob-named dim ({source})"
+        annotated, reason = False, ""
+        if cls == "unbounded":
+            r = _annotation_reason(fi.pf, call.lineno, prov.trail)
+            if r is not None:
+                annotated, reason = True, r
+        dims.append(Dim(name, cls, source, value, annotated, reason))
+    symbol = f"{fi.qualname}"
+    return BeginSite(engine, "".join(parts), dims, fi.pf.rel, symbol,
+                     call.lineno, fi.fid)

@@ -1,0 +1,142 @@
+"""COMPILE_SURFACE.json: build, render, and the runtime matcher.
+
+The committed surface is the static answer to "what is the complete set
+of compile signatures this codebase can ever request?" — per engine,
+the ``compile_watch.begin`` template plus the class of every signature
+dimension, and the full jit entry-point inventory. It is line-number
+free (like HOST_TRANSFER_BUDGET.json) so unrelated edits don't churn
+it, and byte-for-byte drift-gated by scripts/check_all.py and tier-1.
+
+The *matcher* half is what ``perf/compile_watch.finish`` consults to
+stamp each runtime ledger entry ``predicted: true|false``: a runtime
+shape string is predicted when some engine record's template matches it
+and every captured dim value satisfies its static class —
+
+- ``constant``: equals the statically-known value;
+- ``knob``: any non-empty value (finite by configuration);
+- ``bucketed``: an integer in the pow-2 bucket set;
+- ``unbounded``: any value iff the dim carries an ``unbounded-ok``
+  annotation (un-annotated unbounded dims never reach a committed
+  surface — the MPS901 gate forbids them).
+
+An unpredicted runtime compile is an analysis gap: the tier-1 test over
+committed ledger/bench artifacts fails loudly on one.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Sequence
+
+from ...engine.buckets import is_bucket
+from .jits import JitEntry
+from .sigs import BeginSite
+
+SURFACE_BASENAME = "COMPILE_SURFACE.json"
+
+_DIM_RE = re.compile(r"\{([^{}]*)\}")
+
+
+def build_surface(sites: Sequence[BeginSite],
+                  jit_entries: Sequence[JitEntry]) -> Dict[str, object]:
+    engines: Dict[str, List[dict]] = {}
+    for s in sorted(sites, key=lambda s: (s.engine, s.template, s.path)):
+        engines.setdefault(s.engine, []).append({
+            "site": {"path": s.path, "symbol": s.symbol},
+            "template": s.template,
+            "serving": s.serving,
+            "finite": s.finite,
+            "dims": {d.name: d.row() for d in s.dims},
+        })
+    jits = [e.row() for e in sorted(
+        jit_entries, key=lambda e: (e.path, e.symbol)
+    )]
+    finite = all(
+        rec["finite"] for recs in engines.values() for rec in recs
+    )
+    return {
+        "comment": (
+            "Static compile surface (mpcshape MPS9xx): per engine, the "
+            "compile_watch.begin signature template with every dimension "
+            "classified constant/knob/bucketed/unbounded, plus the full "
+            "jit entry-point inventory. perf/compile_watch stamps runtime "
+            "ledger entries predicted:true|false against this file; the "
+            "ROADMAP-item-4 AOT pre-warmer compiles exactly these "
+            "signatures. Regenerate with scripts/mpcshape_surface.py."
+        ),
+        "engines": engines,
+        "jit_entries": jits,
+        "counts": {
+            "engines": len(engines),
+            "signatures": sum(len(v) for v in engines.values()),
+            "jit_entries": len(jits),
+            "finite": finite,
+        },
+    }
+
+
+def render(surface: Dict[str, object]) -> str:
+    return json.dumps(surface, indent=1, ensure_ascii=False) + "\n"
+
+
+# -- runtime matcher ---------------------------------------------------------
+
+
+def load_surface(path: str) -> Optional[Dict[str, object]]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and "engines" in doc else None
+
+
+def _template_regex(template: str) -> "re.Pattern[str]":
+    out: List[str] = []
+    pos = 0
+    i = 0
+    for m in _DIM_RE.finditer(template):
+        out.append(re.escape(template[pos:m.start()]))
+        out.append(f"(?P<d{i}>[^|]*)")
+        i += 1
+        pos = m.end()
+    out.append(re.escape(template[pos:]))
+    return re.compile("^" + "".join(out) + "$")
+
+
+def _dim_ok(row: Dict[str, object], value: str) -> bool:
+    cls = row.get("class")
+    if cls == "constant":
+        want = row.get("value")
+        return value == str(want) if want is not None else bool(value)
+    if cls == "knob":
+        return value != ""
+    if cls == "bucketed":
+        try:
+            return is_bucket(int(value))
+        except ValueError:
+            return False
+    if cls == "unbounded":
+        return bool(row.get("annotated"))
+    return False
+
+
+def shape_predicted(surface: Dict[str, object], engine: str,
+                    shape: str) -> bool:
+    """True when (engine, shape) maps to a static signature record."""
+    for rec in surface.get("engines", {}).get(engine, ()):  # type: ignore[union-attr]
+        template = rec.get("template", "")
+        names = _DIM_RE.findall(template)
+        m = _template_regex(template).match(shape)
+        if m is None:
+            continue
+        dims = rec.get("dims", {})
+        ok = True
+        for i, name in enumerate(names):
+            row = dims.get(name)
+            if row is None or not _dim_ok(row, m.group(f"d{i}")):
+                ok = False
+                break
+        if ok:
+            return True
+    return False

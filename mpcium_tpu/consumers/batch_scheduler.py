@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import wire
+from ..engine.buckets import floor_bucket
 from ..node.node import Node, NotEnoughParticipants
 from ..node.session import Session
 from ..protocol.base import KeygenShare, ProtocolError
@@ -278,6 +279,11 @@ class BatchSigningScheduler:
         self.max_batch = (
             max_batch if max_batch is not None else cfg.batch_max_batch
         )
+        # manifests are cut in pow-2 chunks (engine/buckets.py) so every
+        # batch the engines see is a COMPILE_SURFACE.json signature the
+        # AOT pre-warmer can compile ahead of traffic — a non-pow-2
+        # max_batch only lowers the cap, it never emits an off-bucket size
+        self._chunk_cap = floor_bucket(max(1, self.max_batch))
         self.manifest_timeout_s = (
             manifest_timeout_s
             if manifest_timeout_s is not None
@@ -587,7 +593,7 @@ class BatchSigningScheduler:
                 self._intake_ts.popitem(last=False)
             if self.node.node_id == leader:
                 unfired = sum(1 for e in self._buckets[key] if not e.fired)
-                if unfired >= self.max_batch:
+                if unfired >= self._chunk_cap:
                     fire_after = True
                 else:
                     self._wheel.schedule_if_absent(
@@ -840,8 +846,11 @@ class BatchSigningScheduler:
     def _fire(self, key: Tuple, only_full: bool = False) -> None:
         """Publish manifests covering the bucket's unfired entries, filled
         interactive-lane-first / oldest-deadline-first and drained in
-        max_batch chunks (continuous batching: every full chunk goes now;
-        with ``only_full`` the sub-max remainder waits for its window).
+        pow-2 chunks of at most ``floor_bucket(max_batch)`` (continuous
+        batching: every full chunk goes now; with ``only_full`` the
+        sub-chunk remainder waits for its window). Chunk sizes snap DOWN
+        to the bucket grid — a window flush of 6 entries goes as 4 + 2,
+        never as a one-off 6-wide compile.
         The entries STAY in the bucket (marked fired) until the manifest
         loops back through _on_manifest_raw, which removes them and hands
         their dedup claims to the batch — the same path followers take, so
@@ -856,14 +865,15 @@ class BatchSigningScheduler:
                     e for e in self._buckets.get(key, []) if not e.fired
                 ]
                 if not unfired or (only_full
-                                   and len(unfired) < self.max_batch):
+                                   and len(unfired) < self._chunk_cap):
                     return
                 unfired.sort(key=_Entry.fill_rank)
-                entries = unfired[: self.max_batch]
+                chunk = floor_bucket(min(len(unfired), self._chunk_cap))
+                entries = unfired[:chunk]
                 for e in entries:
                     e.fired = True
                 self._m_batches.inc()
-                self._m_fill.observe(len(entries) / self.max_batch)
+                self._m_fill.observe(len(entries) / self._chunk_cap)
                 for e in entries:
                     self._m_age.observe(now - e.added_at)
             kind = entries[0].kind
@@ -897,8 +907,8 @@ class BatchSigningScheduler:
                 node=self.node.node_id, tid=f"lane:{entries[0].lane}",
                 req_kind=kind, batch=batch_id, n=len(entries),
             )
-            if len(entries) < self.max_batch:
-                return  # bucket drained below a full chunk
+            if len(entries) == len(unfired):
+                return  # bucket drained (sub-bucket tails fired above)
 
     def _fallback_sweep(self, key: Tuple) -> None:
         """Follower liveness, with deputy escalation: when the acting

@@ -1,0 +1,50 @@
+"""Canonical pow-2 shape buckets for the batch/session dimension.
+
+One XLA compile exists per (engine, shape signature), so every distinct
+batch size B the engines are handed is a compile wall paid once and a
+cache entry kept forever. This module is the single source of truth for
+the allowed B values: the scheduler drains manifests in pow-2 chunks
+(consumers/batch_scheduler._fire), bench.py snaps b_sweep points, and
+the ROADMAP-item-4 AOT pre-warmer will compile exactly these buckets.
+
+mpcshape (analysis/shape/) classifies a signature dimension as
+*bucketed* when its provenance flows through these helpers; the
+committed COMPILE_SURFACE.json is finite because everything batch-sized
+on the serving path does.
+
+Pure stdlib on purpose: the scheduler imports this at module load and
+must not pull jax.
+"""
+from __future__ import annotations
+
+BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+_BUCKET_SET = frozenset(BUCKETS)
+
+
+def is_bucket(n: int) -> bool:
+    return n in _BUCKET_SET
+
+
+def floor_bucket(n: int) -> int:
+    """Largest bucket <= n — the chunk size a scheduler drain uses so a
+    manifest (hence the engine batch dim) is always a bucket."""
+    if n < 1:
+        raise ValueError(f"need n >= 1, got {n}")
+    best = BUCKETS[0]
+    for b in BUCKETS:
+        if b > n:
+            break
+        best = b
+    return best
+
+
+def bucket_b(n: int) -> int:
+    """Smallest bucket >= n (clamped to the largest bucket) — the
+    pad-up form bench sweeps and pre-warming use."""
+    if n < 1:
+        raise ValueError(f"need n >= 1, got {n}")
+    for b in BUCKETS:
+        if b >= n:
+            return b
+    return BUCKETS[-1]

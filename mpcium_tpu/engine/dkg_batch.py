@@ -184,6 +184,7 @@ class BatchedDKG:
         _pt = tracing.PhaseTimer(
             "dkg.run", _trace_sync, node="engine", tid=f"dkg:B{B}",
         )
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
         _cw = compile_watch.begin("dkg.run", f"B{B}|q{q}|{self.key_type}")
         xs_tuple = tuple(self.xs[p] for p in self.ids)
         coeffs = jnp.asarray(
@@ -281,6 +282,7 @@ class BatchedReshare:
         _pt = tracing.PhaseTimer(
             "reshare.run", _trace_sync, node="engine", tid=f"reshare:B{B}",
         )
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
         _cw = compile_watch.begin(
             "reshare.run", f"B{B}|{self.key_type}|t{t_new}"
         )

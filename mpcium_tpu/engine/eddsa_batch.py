@@ -347,6 +347,7 @@ class BatchedCoSigners:
         _pt = tracing.PhaseTimer(
             "eddsa.sign", _trace_sync, node="engine", tid=f"eddsa:B{B}",
         )
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
         _cw = compile_watch.begin("eddsa.sign", f"B{B}|q{q}")
 
         # -- round 1: nonce commitments (one (q, B) dispatch) + batch

@@ -1057,6 +1057,7 @@ class GG18BatchCoSigners:
         _mark = _pt.mark
         # first call per (engine, shape-bucket) pays the compile wall:
         # ledger it (one set lookup + None on every later call)
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
         _cw = compile_watch.begin(
             "gg18.sign", f"B{self.B}|q{self.q}|mta={self.mta_impl}"
         )

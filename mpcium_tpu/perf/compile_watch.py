@@ -23,6 +23,13 @@ records a ledger entry::
   ``mark_warming()`` and ``mark_ready()``; today the daemon flips to
   ready once boot completes and entries accrue as traffic compiles.
 
+Each entry is also stamped ``predicted: true|false`` against the
+committed ``COMPILE_SURFACE.json`` (the mpcshape static analysis,
+STATIC_ANALYSIS.md "Compile surface"; ``set_surface_path`` overrides,
+no key when no surface is readable) — the runtime check that every
+compile the fleet actually pays was statically enumerable, i.e. a
+shape the item-4 AOT pre-warmer could have compiled ahead of time.
+
 Persistent-cache hit/miss: the XLA cache dir (when configured) is
 snapshotted at ``begin`` — new files at ``finish`` mean a real compile
 wrote artifacts (``miss``); none mean the executable deserialized from
@@ -48,6 +55,8 @@ _seen: set = set()  # (engine, shape) shape-buckets already ledgered
 _entries: List[dict] = []
 _state = "ready"  # non-daemon default; run_node marks warming at boot
 _ledger_dir: Optional[str] = None  # explicit override (daemon db dir)
+_surface_path: Optional[str] = None  # explicit override (tests)
+_surface: Any = False  # False = not loaded yet; None = load failed
 
 
 class _Token:
@@ -116,6 +125,40 @@ def ledger_path() -> Optional[str]:
     return os.path.join(d, LEDGER_BASENAME) if d else None
 
 
+def set_surface_path(path: Optional[str]) -> None:
+    """Explicit COMPILE_SURFACE.json location (test hook); also drops
+    the cached surface so the next finish() reloads."""
+    global _surface_path, _surface
+    with _lock:
+        _surface_path = path
+        _surface = False
+
+
+def _load_surface():
+    """The committed static compile surface, loaded once per process.
+    None when missing/unreadable — entries then carry no ``predicted``
+    key rather than guessing."""
+    global _surface
+    with _lock:
+        cached = _surface
+        path = _surface_path
+    if cached is not False:
+        return cached
+    # repo-root sibling of HOST_TRANSFER_BUDGET.json; analysis.shape is
+    # pure stdlib (no jax) so this lazy import never warms a backend
+    from ..analysis.shape.surface import SURFACE_BASENAME, load_surface
+
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), SURFACE_BASENAME,
+        )
+    doc = load_surface(path)
+    with _lock:
+        _surface = doc
+    return doc
+
+
 def begin(engine: str, shape: str, **meta: Any) -> Optional[_Token]:
     """Open a warmup observation for (engine, shape). Returns None — one
     set lookup, no timing — when this shape bucket was already ledgered
@@ -151,6 +194,15 @@ def finish(token: Optional[_Token]) -> Optional[dict]:
         "cache": cache,
         "at": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
     }
+    surface = _load_surface()
+    if surface is not None:
+        from ..analysis.shape.surface import shape_predicted
+
+        # an unpredicted compile is an mpcshape analysis gap — the
+        # tier-1 gate over committed artifacts fails loudly on one
+        entry["predicted"] = shape_predicted(
+            surface, token.engine, token.shape
+        )
     for k, v in token.meta.items():
         if isinstance(v, (str, int, float, bool)):
             entry.setdefault(k, v)
@@ -234,9 +286,11 @@ def export_gauges(metrics, ready_states=("ready",)) -> None:
 
 def reset() -> None:
     """Test hook: forget every shape bucket, entry, and state override."""
-    global _state, _ledger_dir
+    global _state, _ledger_dir, _surface_path, _surface
     with _lock:
         _seen.clear()
         _entries.clear()
         _state = "ready"
         _ledger_dir = None
+        _surface_path = None
+        _surface = False

@@ -57,6 +57,7 @@ from ..engine.dkg_batch import (
     _blk_vss_check, _curve, _rand_scalars, _subshare_phase, _xj_bits,
 )
 from ..ops.sha256 import sha256 as dev_sha256
+from ..perf import compile_watch
 from .base import (BatchBlockMixin, KeygenShare, PartyBase, ProtocolError,
                    RoundMsg, party_xs)
 from .ecdsa.keygen import MIN_PAILLIER_BITS
@@ -202,6 +203,9 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
         return f"{self.session_id}:{sender}".encode()
 
     def start(self) -> List[RoundMsg]:
+        B, q = self.B, len(self.party_ids)
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
+        self._cw = compile_watch.begin("party.dkg", f"B{B}|q{q}|{self.key_type}")
         mod, order = _curve(self.key_type)
         self._coeffs = jnp.asarray(
             _rand_scalars((self.tp1, self.B), order, self.rng)
@@ -378,6 +382,7 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
             )
         self.result = shares
         self.done = True
+        compile_watch.finish(self._cw)
 
 
 class BatchedReshareParty(_DealingMixin, PartyBase):
@@ -442,6 +447,11 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
         return f"{self.session_id}:{sender}".encode()
 
     def start(self) -> List[RoundMsg]:
+        B, q, t_new = self.B, len(self.party_ids), self.t_new
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
+        self._cw = compile_watch.begin(
+            "party.reshare", f"B{B}|q{q}|{self.key_type}|t{t_new}"
+        )
         self._stage = 1
         if not self.is_old:
             return []
@@ -588,6 +598,7 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
         if not self.is_new:
             self.result = None
             self.done = True
+            compile_watch.finish(self._cw)
             return
         r3 = self._round_payloads(RS_R3)
         aux: Dict = {"is_reshared": True}
@@ -660,3 +671,4 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
             )
         self.result = shares
         self.done = True
+        compile_watch.finish(self._cw)

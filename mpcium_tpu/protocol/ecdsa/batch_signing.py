@@ -52,6 +52,7 @@ from ...core.bignum import P256
 from ...core.paillier import PaillierPrivateKey, PreParams
 from ...engine import gg18_batch as gb
 from ...ops.paillier_mxu import RAND_BITS
+from ...perf import compile_watch
 from ..base import (BatchBlockMixin, KeygenShare, PartyBase, ProtocolError,
                     RoundMsg, party_xs)
 
@@ -225,6 +226,7 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
             lam_bits = jnp.asarray(
                 sp.scalars_to_bits([self._lam[pid]])[0]
             )
+            # mpclint: disable=MPS902 — intentional: q executables total (one per quorum member's Shamir x, config-bounded); lam_bits stays traced so the batch dim shares one compile
             W, okW = gb._blk_W_from_vss(C_comp, u_xs[pid], lam_bits)
             self.W_pts[pid] = W
             self._ok = self._ok & okW
@@ -273,7 +275,9 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
     # -- round 1 ------------------------------------------------------------
 
     def start(self) -> List[RoundMsg]:
-        B = self.B
+        B, q = self.B, len(self.party_ids)
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
+        self._cw = compile_watch.begin("party.ecdsa", f"B{B}|q{q}")
         rb = gb.rand_bits
         self._k = gb._scalar_from_wide_bytes(jnp.asarray(rb(B, 320, self.rng)))
         self._gamma = gb._scalar_from_wide_bytes(
@@ -649,3 +653,4 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
             "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
         }
         self.done = True
+        compile_watch.finish(self._cw)

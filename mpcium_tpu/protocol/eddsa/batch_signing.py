@@ -33,6 +33,7 @@ import numpy as np
 from ...core import bignum as bn
 from ...core import hostmath as hm
 from ...engine import eddsa_batch as eb
+from ...perf import compile_watch
 from ...utils import tracing
 from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
 
@@ -100,6 +101,12 @@ class BatchedEDDSASigningParty(PartyBase):
         return f"{self.session_id}:{self.self_id}".encode()
 
     def start(self) -> List[RoundMsg]:
+        # party-level compile signature: the whole 3-round session is one
+        # shape bucket — first session per (B, q) pays the warmup, later
+        # ones cost a set lookup (engine-level begin sites nest inside)
+        B, q = self.B, len(self.party_ids)
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
+        self._cw = compile_watch.begin("party.eddsa", f"B{B}|q{q}")
         # device-phase spans: each heavy round materializes its result to
         # host bytes before the span closes, so the interval is honest
         # device time; with tracing off these are the no-op singleton
@@ -201,3 +208,4 @@ class BatchedEDDSASigningParty(PartyBase):
                 "ok": np.asarray(ok) & self._ok_R,
             }
         self.done = True
+        compile_watch.finish(self._cw)

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Regenerate COMPILE_SURFACE.json from the mpcshape sweep.
+
+The committed JSON is the static answer to "what is the complete set of
+compile signatures this codebase can ever request?": per engine, the
+compile_watch.begin template with every dimension classified
+constant/knob/bucketed/unbounded, plus the jit entry-point inventory.
+perf/compile_watch stamps runtime ledger entries predicted:true|false
+against it, and the ROADMAP-item-4 AOT pre-warmer compiles exactly
+these signatures. scripts/check_all.py fails when the committed file
+drifts from the sweep, so run this after any change that adds an
+engine, reshapes a signature, or re-annotates a dimension.
+
+Usage:
+    python scripts/mpcshape_surface.py           # rewrite the JSON
+    python scripts/mpcshape_surface.py --check   # exit 1 on drift, write nothing
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_ROOT))
+
+from mpcium_tpu.analysis.shape import (  # noqa: E402
+    SURFACE_BASENAME,
+    render,
+    run_shape,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="compare against the committed file instead of writing",
+    )
+    args = p.parse_args(argv)
+
+    result, surface = run_shape(root=_ROOT)
+    for f in result.findings:
+        print(f.render())
+    text = render(surface)
+    out = _ROOT / SURFACE_BASENAME
+
+    if args.check:
+        if not out.exists():
+            print(f"{SURFACE_BASENAME} missing — run scripts/mpcshape_surface.py")
+            return 1
+        if out.read_text() != text:
+            print(f"{SURFACE_BASENAME} is stale — run scripts/mpcshape_surface.py")
+            return 1
+        print(f"{SURFACE_BASENAME} in sync")
+        return 0
+
+    out.write_text(text)
+    c = surface["counts"]
+    print(
+        f"wrote {SURFACE_BASENAME}: {c['signatures']} signatures across "
+        f"{c['engines']} engines, {c['jit_entries']} jit entries, "
+        f"finite={c['finite']}"
+    )
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

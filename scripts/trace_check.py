@@ -10,7 +10,7 @@ Three checks, all zero-dependency:
 2. Transcript equality: the SAME deterministic batched-signing run,
    traced and untraced, produces byte-identical round transcripts and
    signatures — tracing must be observationally free.
-3. (unless --no-sweep) the mpclint + mpcflow static gate via
+3. (unless --no-sweep) the mpclint + mpcflow + mpcshape static gate via
    scripts/check_all.py — span attributes that hit the secret taxonomy
    must go through the declassify registry, never into the baseline.
 
@@ -190,7 +190,7 @@ def main(argv=None) -> int:
     ap.add_argument("--regen", action="store_true",
                     help="rebuild TRACE_sample.json from a live run first")
     ap.add_argument("--no-sweep", action="store_true",
-                    help="skip the mpclint/mpcflow sweep (already run by "
+                    help="skip the mpclint/mpcflow/mpcshape sweep (already run by "
                          "the caller, e.g. make check)")
     args = ap.parse_args(argv)
 

@@ -157,3 +157,50 @@ def test_engine_hooks_ledger_real_sign(tmp_path):
     ents = [e for e in compile_watch.entries() if e["engine"] == "eddsa.sign"]
     assert len(ents) == 1
     assert ents[0]["shape"] == "B2|q2"
+    # the runtime shape a real engine requests must be on the committed
+    # static surface — an unpredicted compile is an mpcshape gap
+    assert ents[0]["predicted"] is True
+
+
+def test_predicted_stamped_against_explicit_surface(tmp_path):
+    surface = {
+        "engines": {
+            "e": [{
+                "template": "B{B}|q{q}",
+                "dims": {
+                    "B": {"class": "unbounded", "annotated": True,
+                          "reason": "test"},
+                    "q": {"class": "knob"},
+                },
+            }],
+        },
+    }
+    path = tmp_path / "COMPILE_SURFACE.json"
+    path.write_text(json.dumps(surface))
+    compile_watch.set_surface_path(str(path))
+    entry = compile_watch.finish(compile_watch.begin("e", "B64|q2"))
+    assert entry["predicted"] is True
+    # unknown engine / off-template shape → explicitly unpredicted
+    entry = compile_watch.finish(compile_watch.begin("other", "B64|q2"))
+    assert entry["predicted"] is False
+    entry = compile_watch.finish(compile_watch.begin("e", "B64"))
+    assert entry["predicted"] is False
+
+
+def test_no_predicted_key_when_surface_unreadable(tmp_path):
+    compile_watch.set_surface_path(str(tmp_path / "missing.json"))
+    entry = compile_watch.finish(compile_watch.begin("e", "B64|q2"))
+    assert "predicted" not in entry  # no surface: no guessing
+
+
+def test_default_surface_is_the_committed_artifact():
+    """With no override, finish() consults the repo-root
+    COMPILE_SURFACE.json — engine shapes of every class match."""
+    entry = compile_watch.finish(
+        compile_watch.begin("gg18.sign", "B1024|q2|mta=ot")
+    )
+    assert entry["predicted"] is True
+    entry = compile_watch.finish(
+        compile_watch.begin("gg18.sign", "B1024|q2")  # template mismatch
+    )
+    assert entry["predicted"] is False

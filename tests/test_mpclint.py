@@ -59,8 +59,9 @@ def test_baseline_is_small_and_justified():
     baseline = load_baseline(ROOT / DEFAULT_BASELINE)
     assert len(baseline.entries) <= MAX_BASELINE_ENTRIES
     for fp, justification in baseline.entries.items():
-        # mpclint (MPL) and mpcflow (MPF) share the baseline + format
-        assert fp.startswith(("MPL", "MPF")), fp
+        # mpclint (MPL), mpcflow (MPF), mpcshape (MPS) share the
+        # baseline + format
+        assert fp.startswith(("MPL", "MPF", "MPS")), fp
         # load_baseline enforces non-empty; require a real sentence here
         assert len(justification) > 20, (fp, justification)
         if fp.startswith("MPF"):

@@ -1,0 +1,174 @@
+"""Tier-1 mpcshape gate: the compile-surface analysis over the whole
+package.
+
+This is ``make shapecheck`` as a test: any non-baselined MPS9xx finding
+fails, any stale MPS baseline entry fails, the committed
+COMPILE_SURFACE.json must match the sweep exactly, every engine's
+signature set must be finite (no un-annotated unbounded dims — the
+precondition for ROADMAP-item-4 AOT pre-warming), and the sweep must
+stay fast enough to live in tier-1. The committed bench artifacts are
+cross-checked against the surface: every compile signature a committed
+BENCH record implies must be one the static analysis predicted.
+"""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu.analysis import load_baseline
+from mpcium_tpu.analysis.baseline import DEFAULT_BASELINE
+from mpcium_tpu.analysis.shape import render, run_shape, shape_predicted
+
+pytestmark = pytest.mark.lint
+
+ROOT = Path(__file__).resolve().parents[1]
+SURFACE_PATH = ROOT / "COMPILE_SURFACE.json"
+
+# every engine that calls compile_watch.begin today; a new engine must
+# appear here AND in the regenerated surface in the same commit
+EXPECTED_ENGINES = {
+    "gg18.sign", "eddsa.sign", "dkg.run", "reshare.run",
+    "party.ecdsa", "party.eddsa", "party.dkg", "party.reshare",
+}
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    t0 = time.monotonic()
+    result, surface = run_shape(root=ROOT)
+    elapsed = time.monotonic() - t0
+    return result, surface, elapsed
+
+
+def test_package_parses_clean(sweep):
+    result, _surface, _elapsed = sweep
+    assert not result.parse_errors, result.parse_errors
+    assert result.files_scanned > 60
+
+
+def test_no_new_findings_no_stale_entries(sweep):
+    result, _surface, _elapsed = sweep
+    baseline = load_baseline(ROOT / DEFAULT_BASELINE)
+    # MPS scope: stale MPL/MPF entries are the other gates' business
+    new, _grandfathered, stale = baseline.split(
+        result.findings, scope=("MPS",)
+    )
+    assert not new, "non-baselined compile-surface findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, (
+        "stale mpcshape baseline entries (the baseline only shrinks):\n"
+        + "\n".join(stale)
+    )
+
+
+def test_sweep_is_tier1_fast(sweep):
+    _result, _surface, elapsed = sweep
+    assert elapsed < 30, f"mpcshape sweep took {elapsed:.1f}s"
+
+
+def test_surface_matches_committed_json(sweep):
+    _result, surface, _elapsed = sweep
+    assert SURFACE_PATH.exists(), (
+        "COMPILE_SURFACE.json missing — run scripts/mpcshape_surface.py"
+    )
+    assert SURFACE_PATH.read_text() == render(surface), (
+        "COMPILE_SURFACE.json drifted from the sweep — regenerate with "
+        "scripts/mpcshape_surface.py and review the diff"
+    )
+
+
+def test_every_engine_signature_set_is_finite(sweep):
+    _result, surface, _elapsed = sweep
+    assert set(surface["engines"]) == EXPECTED_ENGINES
+    infinite = [
+        (eng, rec["template"])
+        for eng, recs in surface["engines"].items()
+        for rec in recs
+        if not rec["finite"]
+    ]
+    assert not infinite, (
+        "engines with unbounded un-annotated signature dims (the AOT "
+        f"pre-warmer cannot enumerate them): {infinite}"
+    )
+    assert surface["counts"]["finite"] is True
+
+
+def test_surface_is_line_number_free(sweep):
+    """Unrelated edits must not churn the committed artifact."""
+    _result, surface, _elapsed = sweep
+    def walk(obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                assert k not in ("line", "lineno"), f"line number under {k}"
+                walk(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                walk(v)
+    walk(surface)
+
+
+def test_jit_inventory_covers_known_entry_points(sweep):
+    _result, surface, _elapsed = sweep
+    symbols = {e["symbol"] for e in surface["jit_entries"]}
+    # spot anchors across the jit-bearing modules: a decorated engine
+    # kernel, a partial(jax.jit) with statics, and a wrapped assignment
+    assert "_commit_phase" in symbols  # engine/dkg_batch.py
+    assert any(s.startswith("_blk_") for s in symbols)  # gg18_batch.py
+    assert surface["counts"]["jit_entries"] >= 50
+
+
+def _bench_shapes():
+    """(engine, shape) pairs the committed bench artifacts imply, using
+    bench.py's own construction: gg18 signs with quorum ids[:2]; the
+    secondary suite runs ed25519 at max(B, 4096), DKG over all 3 ids at
+    threshold 1 on secp256k1, and a 2-of-3 → 3-of-5 reshare at B//4."""
+    shapes = []
+    for name in ("BENCH_TPU_LATEST.json", "BENCH_TPU_OT.json"):
+        p = ROOT / name
+        if not p.exists():
+            continue
+        doc = json.loads(p.read_text())
+        b, mta = doc.get("batch"), doc.get("mta")
+        if not isinstance(b, int) or not isinstance(mta, str):
+            continue
+        shapes.append(("gg18.sign", f"B{b}|q2|mta={mta}"))
+        be = max(b, 4096) if b >= 256 else b
+        shapes.append(("eddsa.sign", f"B{be}|q2"))
+        shapes.append(("dkg.run", f"B{b}|q3|secp256k1"))
+        shapes.append(("reshare.run", f"B{max(b // 4, 1)}|secp256k1|t2"))
+    return shapes
+
+
+def test_committed_bench_artifacts_are_predicted(sweep):
+    _result, surface, _elapsed = sweep
+    shapes = _bench_shapes()
+    assert shapes, "no committed bench artifacts with batch/mta context"
+    unpredicted = [
+        (eng, shape)
+        for eng, shape in shapes
+        if not shape_predicted(surface, eng, shape)
+    ]
+    assert not unpredicted, (
+        "committed bench records imply compile signatures the static "
+        f"surface does not predict (analysis gap): {unpredicted}"
+    )
+
+
+def test_committed_compile_ledgers_are_predicted(sweep):
+    """Every compile entry in any committed COMPILE_LEDGER.json must map
+    to a predicted signature (none are committed today — the test is the
+    contract for when one lands)."""
+    _result, surface, _elapsed = sweep
+    for p in ROOT.glob("**/COMPILE_LEDGER.json"):
+        if "__pycache__" in str(p) or ".jax_cache" in str(p):
+            continue
+        doc = json.loads(p.read_text())
+        for e in doc.get("entries", []):
+            assert shape_predicted(surface, e["engine"], e["shape"]), (
+                f"{p}: ledgered compile ({e['engine']}, {e['shape']}) "
+                "is not on the static surface"
+            )

@@ -1,0 +1,452 @@
+"""Per-rule mpcshape unit tests: positive + negative snippets for each
+MPS9xx rule, signature-template extraction and dim classification, the
+``# mpcshape: unbounded-ok`` annotation, suppression syntax, the pow-2
+bucket helpers, and the COMPILE_SURFACE runtime matcher semantics.
+"""
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu.analysis.core import ParsedFile
+from mpcium_tpu.analysis.shape import (
+    build_surface,
+    run_shape_parsed,
+    shape_predicted,
+)
+from mpcium_tpu.engine.buckets import BUCKETS, bucket_b, floor_bucket, is_bucket
+
+pytestmark = pytest.mark.lint
+
+REL = "mpcium_tpu/engine/snippet.py"
+
+
+def sweep(src: str, rel: str = REL, serving=()):
+    pf = ParsedFile(Path(rel), rel, textwrap.dedent(src))
+    return run_shape_parsed([pf], serving_roots=serving)
+
+
+def rule_ids(result):
+    return [f.rule for f in result.findings]
+
+
+# -- pow-2 buckets ----------------------------------------------------------
+
+
+def test_bucket_helpers():
+    assert all(is_bucket(b) for b in BUCKETS)
+    assert not is_bucket(3) and not is_bucket(0) and not is_bucket(8193)
+    assert floor_bucket(1) == 1
+    assert floor_bucket(6) == 4
+    assert floor_bucket(8192) == 8192
+    assert floor_bucket(100000) == 8192
+    assert bucket_b(1) == 1
+    assert bucket_b(5) == 8
+    assert bucket_b(1024) == 1024
+    assert bucket_b(100000) == 8192  # clamped to the largest bucket
+    with pytest.raises(ValueError):
+        floor_bucket(0)
+    with pytest.raises(ValueError):
+        bucket_b(0)
+
+
+# -- signature extraction + dim classes -------------------------------------
+
+
+ENGINE_SNIPPET = """
+import os
+from mpcium_tpu.perf import compile_watch
+from mpcium_tpu.engine.buckets import floor_bucket
+
+def serve(shares, party_ids):
+    B = len(shares)
+    q = len(party_ids)
+    mta = os.environ.get("MPCIUM_MTA", "paillier")
+    nb = floor_bucket(len(shares))
+    _cw = compile_watch.begin("snip.sign", f"B{B}|q{q}|mta={mta}|n{nb}")
+    compile_watch.finish(_cw)
+"""
+
+
+def test_template_and_dim_classes():
+    result, surface = sweep(ENGINE_SNIPPET)
+    recs = surface["engines"]["snip.sign"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["template"] == "B{B}|q{q}|mta={mta}|n{nb}"
+    dims = rec["dims"]
+    assert dims["B"]["class"] == "unbounded"  # len() provenance
+    assert dims["q"]["class"] == "knob"  # knob-named regardless of len()
+    assert dims["mta"]["class"] == "knob"  # env read
+    assert dims["nb"]["class"] == "bucketed"  # floor_bucket provenance
+    assert rec["finite"] is False  # un-annotated unbounded B
+
+
+def test_mps901_unbounded_on_serving_path():
+    result, _ = sweep(ENGINE_SNIPPET, serving={f"{REL}::serve"})
+    assert rule_ids(result) == ["MPS901"]
+    assert result.findings[0].key == "snip.sign:B"
+    # the same site off the serving set does not fire
+    result, _ = sweep(ENGINE_SNIPPET, serving=set())
+    assert rule_ids(result) == []
+
+
+def test_mps901_annotation_clears_and_records_reason():
+    src = """
+    from mpcium_tpu.perf import compile_watch
+
+    def serve(shares):
+        B = len(shares)
+        # mpcshape: unbounded-ok — scheduler chunks to pow-2
+        _cw = compile_watch.begin("snip.sign", f"B{B}")
+        compile_watch.finish(_cw)
+    """
+    result, surface = sweep(src, serving={f"{REL}::serve"})
+    assert rule_ids(result) == []
+    rec = surface["engines"]["snip.sign"][0]
+    assert rec["finite"] is True
+    d = rec["dims"]["B"]
+    assert d["annotated"] is True
+    assert "pow-2" in d["reason"]
+
+
+def test_mps901_annotation_on_provenance_line():
+    src = """
+    from mpcium_tpu.perf import compile_watch
+
+    class P:
+        def __init__(self, shares):
+            # mpcshape: unbounded-ok — bounded by the intake cap
+            self.B = len(shares)
+
+        def serve(self):
+            _cw = compile_watch.begin("snip.sign", f"B{self.B}")
+            compile_watch.finish(_cw)
+    """
+    result, surface = sweep(src, serving={f"{REL}::P.serve"})
+    assert rule_ids(result) == []
+    assert surface["engines"]["snip.sign"][0]["finite"] is True
+
+
+def test_constant_dim_and_self_attr_provenance():
+    src = """
+    from mpcium_tpu.perf import compile_watch
+
+    class P:
+        def __init__(self, q):
+            self.q = q
+            self.width = 22
+
+        def serve(self):
+            _cw = compile_watch.begin("snip.x", f"q{self.q}|w{self.width}")
+            compile_watch.finish(_cw)
+    """
+    _, surface = sweep(src)
+    dims = surface["engines"]["snip.x"][0]["dims"]
+    assert dims["q"]["class"] == "knob"
+    assert dims["width"]["class"] == "constant"
+    assert dims["width"]["value"] == 22
+
+
+def test_mps_suppression_syntax():
+    src = """
+    from mpcium_tpu.perf import compile_watch
+
+    def serve(shares):
+        B = len(shares)
+        # mpclint: disable=MPS901 — covered by an intake cap
+        _cw = compile_watch.begin("snip.sign", f"B{B}")
+        compile_watch.finish(_cw)
+    """
+    result, _ = sweep(src, serving={f"{REL}::serve"})
+    assert rule_ids(result) == []
+
+
+# -- MPS902 retrace-per-call ------------------------------------------------
+
+
+def test_mps902_loop_var_into_static_param():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def kern(x, k):
+        return x
+
+    def caller(xs, party_ids):
+        for pid in party_ids:
+            kern(xs, pid)
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS902"]
+    assert result.findings[0].key == "kern:k:loop"
+
+
+def test_mps902_len_into_static_param():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def kern(x, k):
+        return x
+
+    def caller(xs):
+        return kern(xs, len(xs))
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS902"]
+    assert result.findings[0].key == "kern:k:len"
+
+
+def test_mps902_constant_static_arg_is_fine():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def kern(x, k):
+        return x
+
+    def caller(xs):
+        for _ in range(3):
+            kern(xs, 22)
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == []
+
+
+# -- MPS903 large closure constants -----------------------------------------
+
+
+def test_mps903_large_module_array_in_jit_body():
+    src = """
+    import jax
+    import numpy as np
+
+    TABLE = np.zeros((64, 128))
+
+    @jax.jit
+    def f(x):
+        return x + TABLE
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS903"]
+    assert result.findings[0].key == "f:TABLE"
+
+
+def test_mps903_small_or_passed_arrays_are_fine():
+    src = """
+    import jax
+    import numpy as np
+
+    SMALL = np.arange(16)
+    BIG = np.zeros(65536)
+
+    @jax.jit
+    def f(x, table):
+        return x + SMALL + table
+
+    def caller(x):
+        return f(x, BIG)  # passed as an argument: operand, not constant
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == []
+
+
+# -- MPS904 dtype instability -----------------------------------------------
+
+
+def test_mps904_conflicting_dtypes_across_call_sites():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def g(x):
+        return x
+
+    def a():
+        return g(jnp.zeros(4, dtype=jnp.float32))
+
+    def b():
+        return g(jnp.zeros(4, dtype=jnp.int32))
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS904"]
+    assert result.findings[0].key == "g:x"
+
+
+def test_mps904_consistent_dtype_is_fine():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def g(x):
+        return x
+
+    def a():
+        return g(jnp.zeros(4, dtype=jnp.uint8))
+
+    def b():
+        return g(jnp.ones(8, dtype=jnp.uint8))
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == []
+
+
+# -- MPS905 vmap axes / donation --------------------------------------------
+
+
+def test_mps905_nonconstant_vmap_axes():
+    src = """
+    import jax
+
+    def core(x):
+        return x
+
+    def mk(axes):
+        return jax.vmap(core, in_axes=axes)
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS905"]
+
+
+def test_mps905_donated_buffer_read_after_call():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(buf):
+        return buf + 1
+
+    def run(buf):
+        out = step(buf)
+        return buf + out
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS905"]
+    assert result.findings[0].key == "step:buf:donated-reuse"
+
+
+def test_mps905_literal_axes_and_clean_donation_are_fine():
+    src = """
+    import functools
+    import jax
+
+    def core(x):
+        return x
+
+    batched = jax.vmap(core, in_axes=(0,))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(buf):
+        return buf + 1
+
+    def run(buf):
+        return step(buf)
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == []
+
+
+# -- jit inventory ----------------------------------------------------------
+
+
+def test_jit_inventory_kinds_and_static_resolution():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def decorated(x, mode):
+        return x
+
+    def core(x, n):
+        return x
+
+    wrapped = jax.jit(core, static_argnums=(1,))
+    batched = jax.vmap(core, in_axes=(0, None))
+    """
+    _, surface = sweep(src)
+    rows = {e["symbol"]: e for e in surface["jit_entries"]}
+    assert rows["decorated"]["kind"] == "jit"
+    assert rows["decorated"]["static"] == ["mode"]
+    assert rows["wrapped"]["kind"] == "wrapped"
+    assert rows["wrapped"]["static"] == ["n"]  # argnum mapped to a name
+    assert rows["batched"]["kind"] == "vmap"
+
+
+# -- runtime matcher --------------------------------------------------------
+
+
+def _surface_for(src, serving=()):
+    _, surface = sweep(src, serving=serving)
+    return surface
+
+
+def test_shape_predicted_matcher_semantics():
+    surface = _surface_for("""
+    import os
+    from mpcium_tpu.perf import compile_watch
+    from mpcium_tpu.engine.buckets import floor_bucket
+
+    def serve(shares, party_ids):
+        # mpcshape: unbounded-ok — pow-2 chunked upstream
+        B = len(shares)
+        q = len(party_ids)
+        mta = os.environ.get("MPCIUM_MTA", "paillier")
+        nb = floor_bucket(len(shares))
+        _cw = compile_watch.begin("snip.sign", f"B{B}|q{q}|mta={mta}|n{nb}")
+        compile_watch.finish(_cw)
+
+    class P:
+        def __init__(self):
+            self.width = 22
+
+        def serve(self):
+            _cw = compile_watch.begin("snip.x", f"w{self.width}")
+            compile_watch.finish(_cw)
+    """)
+    # annotated-unbounded B: any value; knob q/mta: any non-empty;
+    # bucketed nb: pow-2 members only
+    assert shape_predicted(surface, "snip.sign", "B4096|q2|mta=ot|n1024")
+    assert shape_predicted(surface, "snip.sign", "B7|q3|mta=paillier|n8")
+    assert not shape_predicted(surface, "snip.sign", "B7|q3|mta=ot|n100")
+    assert not shape_predicted(surface, "snip.sign", "B7|q|mta=ot|n8")
+    assert not shape_predicted(surface, "snip.sign", "B7|q2|mta=ot")
+    # constant dim: exact value
+    assert shape_predicted(surface, "snip.x", "w22")
+    assert not shape_predicted(surface, "snip.x", "w23")
+    # unknown engine never predicted
+    assert not shape_predicted(surface, "nope", "B1")
+
+
+def test_unannotated_unbounded_dim_never_matches():
+    surface = _surface_for("""
+    from mpcium_tpu.perf import compile_watch
+
+    def helper(shares):
+        B = len(shares)
+        _cw = compile_watch.begin("snip.h", f"B{B}")
+        compile_watch.finish(_cw)
+    """)
+    # off the serving path: no MPS901, but the matcher still refuses —
+    # an unbounded dim with no contract is an analysis gap at runtime
+    assert not shape_predicted(surface, "snip.h", "B64")
+
+
+def test_surface_counts_and_render_shape():
+    result, surface = sweep(ENGINE_SNIPPET)
+    assert surface["counts"]["engines"] == 1
+    assert surface["counts"]["signatures"] == 1
+    assert surface["counts"]["finite"] is False
+    rebuilt = build_surface([], [])
+    assert rebuilt["counts"] == {
+        "engines": 0, "signatures": 0, "jit_entries": 0, "finite": True,
+    }

@@ -167,17 +167,18 @@ def test_fire_fills_interactive_first_oldest_deadline_first(fabric):
                 for t, lane, dl in order
             ]
         s._fire(KEY)
-        # continuous drain: one FULL chunk in fill order, then the
-        # remainder in its own manifest
+        # continuous drain in pow-2 chunks: max_batch=3 snaps to a chunk
+        # cap of floor_bucket(3)=2, so the 4 entries go as two full
+        # bucket-grid manifests in fill order — never a one-off 3-wide
+        # compile shape
         assert _wait_for(lambda: len(manifests) == 2), (
             f"expected 2 manifests, got {len(manifests)}"
         )
         txs = [r["msg"]["tx_id"] for r in manifests[0]["requests"]]
-        # max_batch=3: both interactive entries first (oldest deadline
-        # leading), then the sooner bulk
-        assert txs == ["int-soon", "int-late", "bulk-soon"]
+        # both interactive entries first (oldest deadline leading)
+        assert txs == ["int-soon", "int-late"]
         rest = [r["msg"]["tx_id"] for r in manifests[1]["requests"]]
-        assert rest == ["bulk-late"]
+        assert rest == ["bulk-soon", "bulk-late"]
         assert s.metrics.counter("scheduler.batches_fired_total").value == 2
         fill = s.metrics.get("scheduler.batch_fill_ratio")
         assert fill.count == 2 and fill.max == 1.0
