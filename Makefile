@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install lint check shapecheck trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check shapecheck warmcheck prewarm trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -36,6 +36,18 @@ check:
 # signature change, review the diff, commit the JSON.
 shapecheck:
 	$(PY) scripts/mpcshape_surface.py --check
+
+# warm-manifest gate alone (PERFORMANCE.md "Warm start"): the pre-warm
+# work-list must enumerate exactly surface knobs × engine/buckets with
+# no silent gaps — pure stdlib, no jax. Also folded into check_all.
+warmcheck:
+	$(PY) scripts/prewarm.py --check
+
+# fill the XLA persistent cache for this host's serving set (the same
+# pass the daemon runs at boot with warm_enabled; see scripts/prewarm.py
+# for scheme/bucket/budget flags)
+prewarm:
+	$(PY) scripts/prewarm.py
 
 # statistical perf-regression gate alone (PERFORMANCE.md "perf
 # observatory"): micro-benches vs the committed PERF_baseline_micro.json

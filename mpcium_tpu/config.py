@@ -49,6 +49,14 @@ class AppConfig:
     chaos_fault_plan: str = ""  # path to a faults.FaultPlan JSON ("" = off)
     session_wal: bool = False  # encrypted per-round session WAL + crash resume
     peers_file: str = "peers.json"
+    # warm-start pass (mpcium_tpu.warm): pre-compile the serving set at
+    # boot between mark_warming() and mark_ready() — see PERFORMANCE.md
+    # "Warm start"
+    warm_enabled: bool = False
+    warm_budget_s: float = 300.0  # boot stays "warming" at most this long
+    warm_schemes: str = "eddsa"  # comma list of eddsa,ecdsa,dkg,reshare ("" = all)
+    warm_max_b: int = 64  # largest batch bucket to pre-warm
+    warm_cache_dir: str = ""  # "" = <db_dir>/<node>/warm_cache_<hostfp>
 
     def to_json(self, mask_secrets: bool = True) -> Dict[str, Any]:
         out = {}

@@ -210,25 +210,36 @@ class PartyCtx:
     verification contexts. Decryption obviously stays private-only.
     """
 
-    def __init__(self, pid: str, pre: PreParams, rng=secrets):
+    def __init__(
+        self,
+        pid: str,
+        pre: Optional[PreParams],
+        rng=secrets,
+        *,
+        public_material: Optional[Tuple[int, int, int, int]] = None,
+    ):
         self.pid = pid
         self.pre = pre
-        self.pmx = PaillierMXUPrivate(pre.paillier, rng=rng)
-        self._common(pre.paillier.N, pre.NTilde, pre.h1, pre.h2)
+        if public_material is not None:
+            if pre is not None:
+                raise ValueError("pass private PreParams OR public material")
+            from ..core.paillier import PaillierPublicKey
+            from ..ops.paillier_mxu import PaillierMXU
+
+            N, NTilde, h1, h2 = public_material
+            self.pmx = PaillierMXU(PaillierPublicKey(N), rng=rng)
+            self._common(N, NTilde, h1, h2)
+        else:
+            if pre is None:
+                raise ValueError("private PartyCtx requires PreParams")
+            self.pmx = PaillierMXUPrivate(pre.paillier, rng=rng)
+            self._common(pre.paillier.N, pre.NTilde, pre.h1, pre.h2)
 
     @classmethod
     def public(
         cls, pid: str, N: int, NTilde: int, h1: int, h2: int, rng=secrets
     ) -> "PartyCtx":
-        from ..core.paillier import PaillierPublicKey
-        from ..ops.paillier_mxu import PaillierMXU
-
-        obj = cls.__new__(cls)
-        obj.pid = pid
-        obj.pre = None
-        obj.pmx = PaillierMXU(PaillierPublicKey(N), rng=rng)
-        obj._common(N, NTilde, h1, h2)
-        return obj
+        return cls(pid, None, rng, public_material=(N, NTilde, h1, h2))
 
     def _common(self, N: int, NTilde: int, h1: int, h2: int) -> None:
         self.N = N
@@ -949,9 +960,11 @@ class GG18BatchCoSigners:
         self,
         party_ids: Sequence[str],
         party_shares: Sequence[Sequence[KeygenShare]],
-        preparams: Dict[str, PreParams],
+        preparams: Optional[Dict[str, PreParams]] = None,
         dom: Domains = Domains(),
         rng=secrets,
+        *,
+        mta_impl: Optional[str] = None,
     ):
         self.q = len(party_ids)
         assert self.q >= 2, "need at least a 2-party quorum"
@@ -973,11 +986,16 @@ class GG18BatchCoSigners:
             if a != b
         ]
         # MtA implementation: "paillier" (default — the GG18 MtA with
-        # range proofs) or "ot" (experimental OT-based Gilboa
+        # range proofs), "ot" (experimental OT-based Gilboa
         # multiplication, protocol.ecdsa.mta_ot: no Paillier anywhere in
-        # signing, passive security — see SECURITY.md "OT-MtA")
+        # signing, passive security — see SECURITY.md "OT-MtA"), or
+        # "none" (curve state only — no MtA contexts, cannot sign();
+        # the multichip dryrun builds its sharding probe this way via
+        # :meth:`curve_only` instead of hand-wiring ``__new__``)
         self.mta_impl = os.environ.get("MPCIUM_MTA", "paillier")
-        if self.mta_impl not in ("paillier", "ot"):
+        if mta_impl is not None:
+            self.mta_impl = mta_impl
+        if self.mta_impl not in ("paillier", "ot", "none"):
             raise ValueError(
                 f"MPCIUM_MTA={self.mta_impl!r}: expected 'paillier' or 'ot'"
             )
@@ -992,7 +1010,13 @@ class GG18BatchCoSigners:
                 )
                 for (a, b) in self.pairs
             }
+        elif self.mta_impl == "none":
+            self.ctx = None
+            self.mta = None
+            self.ot_legs = None
         else:
+            if preparams is None:
+                raise ValueError("mta_impl='paillier' requires preparams")
             self.ctx = [PartyCtx(pid, preparams[pid], rng) for pid in party_ids]
             self.mta = {
                 (a, b): MtaBatch(self.ctx[a], self.ctx[b], dom)
@@ -1016,6 +1040,18 @@ class GG18BatchCoSigners:
         # wallet public keys (host decompress once at setup)
         pubs = [hm.secp_decompress(s.public_key) for s in party_shares[0]]
         self.Y = sp.from_host(pubs)
+
+    @classmethod
+    def curve_only(
+        cls,
+        party_ids: Sequence[str],
+        party_shares: Sequence[Sequence[KeygenShare]],
+        rng=secrets,
+    ) -> "GG18BatchCoSigners":
+        """Curve state (w, W_pts, Y) without any MtA machinery — for
+        sharding probes and dryruns that exercise the batched point math
+        but never run the signing protocol. ``sign()`` raises."""
+        return cls(party_ids, party_shares, None, rng=rng, mta_impl="none")
 
     # -- small helpers -------------------------------------------------------
 
@@ -1050,6 +1086,10 @@ class GG18BatchCoSigners:
         armed), the engine blocks at phase boundaries and records wall
         seconds per protocol phase as ``phase:*`` spans plus the legacy
         dict (bench diagnostics; adds sync overhead only then)."""
+        if self.mta_impl == "none":
+            raise RuntimeError(
+                "curve_only signer has no MtA contexts — cannot sign()"
+            )
         _pt = tracing.PhaseTimer(
             "gg18.sign", _trace_sync, phase_times=phase_times,
             node="engine", tid=f"gg18:B{self.B}",

@@ -65,6 +65,9 @@ class DrillReport:
     error: str = ""
     # kill-resume: wall time from respawn to the resumed session's result
     resume_latency_s: float = 0.0
+    # kill-resume: warm-cache stats from the pre-respawn warm pass
+    # ({warmed, hits, budget_s} — mpcium_tpu.warm.prewarm.warm_for_drill)
+    warm: dict = field(default_factory=dict)
     # merged cross-node Chrome-trace-event JSON (flight-recorder snapshot;
     # load in Perfetto / chrome://tracing)
     trace: dict = field(default_factory=dict)
@@ -82,6 +85,7 @@ class DrillReport:
             "notes": self.notes,
             "error": self.error,
             "resume_latency_s": round(self.resume_latency_s, 3),
+            "warm": self.warm,
             "trace": self.trace,
         }
 
@@ -450,8 +454,14 @@ def _drill_kill_resume(seed: int, scale: float):
     and finish with the bit-identical signature on every node.
     """
     from ..core import hostmath as hm
+    from ..warm.prewarm import warm_for_drill
     from .plan import crash_node
 
+    # warm the drill's signing bucket BEFORE any session is live (a warm
+    # pass mid-drill would stall the survivors past their round
+    # timeouts) so resume_latency_s measures recovery, not the compile
+    # wall — the warm stats ride the report beside it
+    warm_stats = warm_for_drill()
     plan = FaultPlan(
         seed, [crash_node("node2", at_round="eddsa/sign/2", topic="sign:*")]
     )
@@ -554,7 +564,8 @@ def _drill_kill_resume(seed: int, scale: float):
         notes.append(f"node2 WAL drained after completion: {wal_drained}")
         ok = stalled and sig_ok and identical and wal_drained
         return ("resumed" if ok else "degraded", ok, notes, plan.to_json(),
-                faults, {"resume_latency_s": resume_latency})
+                faults,
+                {"resume_latency_s": resume_latency, "warm": warm_stats})
     finally:
         _close(cluster, root)
 

@@ -222,9 +222,16 @@ def run_node(
         target=health_loop, args=(consumer, control_kv, name, health_stop),
         name=f"health-{name}", daemon=True,
     ).start()
-    # every subsystem is wired and subscribed: flip the compile-ledger
-    # state to ready (shape warmups from live traffic keep accruing to
-    # the ledger; a future warm-start pass would run before this line)
+    # every subsystem is wired and subscribed. With warm_enabled the
+    # warm-start pass now pre-compiles the serving set (knobs × buckets
+    # read from COMPILE_SURFACE.json) while health still publishes
+    # state=warming — the node advertises ready only once the manifest
+    # is covered or warm_budget_s expires. Cold boot (warm_enabled
+    # false) flips straight to ready and live traffic pays the wall.
+    if cfg.warm_enabled:
+        from ..warm.prewarm import prewarm_for_daemon
+
+        prewarm_for_daemon(cfg, name)
     compile_watch.mark_ready()
     log.info("node running", node=name, broker=f"{cfg.broker_host}:{cfg.broker_port}")
 

@@ -237,6 +237,14 @@ def entries() -> List[dict]:
         return list(_entries)
 
 
+def seen(engine: str, shape: str) -> bool:
+    """True when this (engine, shape) bucket was already ledgered in this
+    process — the pre-warmer skips work an earlier pass (or live traffic)
+    has already paid for."""
+    with _lock:
+        return (engine, shape) in _seen
+
+
 def mark_warming() -> None:
     """Daemon boot: kernels for this node's shapes are not compiled yet.
     A node publishing ``warming`` is alive-but-cold — the health state
@@ -260,11 +268,15 @@ def health_summary() -> Dict[str, object]:
         state = _state
     hits = sum(1 for e in ents if e["cache"] == "hit")
     misses = sum(1 for e in ents if e["cache"] == "miss")
+    # predicted: False = a compile the static surface did not enumerate —
+    # drift that escaped the mpcshape gate, visible at runtime
+    unpredicted = sum(1 for e in ents if e.get("predicted") is False)
     return {
         "state": state,
         "compiles": len(ents),
         "cache_hits": hits,
         "cache_misses": misses,
+        "unpredicted": unpredicted,
         "total_compile_s": round(sum(e["compile_s"] for e in ents), 3),
         "last": ents[-1] if ents else None,
         "ledger": ledger_path(),
@@ -281,6 +293,7 @@ def export_gauges(metrics, ready_states=("ready",)) -> None:
     metrics.gauge("compile.count").set(float(s["compiles"]))
     metrics.gauge("compile.cache_hits").set(float(s["cache_hits"]))
     metrics.gauge("compile.cache_misses").set(float(s["cache_misses"]))
+    metrics.gauge("compile.unpredicted").set(float(s["unpredicted"]))
     metrics.gauge("compile.seconds_total").set(float(s["total_compile_s"]))
 
 

@@ -1,0 +1,27 @@
+"""mpcwarm — shape-bucketed AOT compile cache and warm-start pass.
+
+The compile surface is *data* (``COMPILE_SURFACE.json``), so erasing
+the compile wall is a table walk, not a heuristic: :mod:`.manifest`
+enumerates knobs × buckets into a prioritized work-list, :mod:`.aot`
+persists ``jax.export`` artifacts with loud environment-key
+invalidation, and :mod:`.prewarm` walks the list at daemon boot between
+``compile_watch.mark_warming()`` and ``mark_ready()``. See
+PERFORMANCE.md "Warm start" and ROADMAP item 4.
+
+This package never imports jax at module scope — manifest enumeration
+and ``make warmcheck`` stay sub-second and host-only.
+"""
+from .manifest import (  # noqa: F401
+    ALL_SCHEMES,
+    REPORT_BASENAME,
+    WarmEntry,
+    WarmKnobs,
+    build_manifest,
+    coverage_check,
+    default_knobs,
+    key_matches,
+    knobs_from_config,
+    load_default_surface,
+    manifest_entries,
+    manifest_key,
+)

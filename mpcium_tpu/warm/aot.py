@@ -1,0 +1,192 @@
+"""AOT executable serialization: ``jax.export`` artifacts beside the cache.
+
+Two warm mechanisms complement each other (ROADMAP item 4):
+
+- the **XLA persistent cache** (``jax_compilation_cache_dir``) caches
+  every compiled executable keyed by lowered HLO — the pre-warmer's
+  trace-and-compile runners populate it for whole engine paths, and a
+  restarted process deserializes instead of recompiling. This is the
+  universal fallback: it covers callables ``jax.export`` cannot
+  (host-callback-bearing, multi-dispatch protocol drivers).
+- **``jax.export`` artifacts** (this module) serialize individual
+  flagship kernels to versioned ``.bin`` files that a booting process
+  can deserialize and call directly — no Python retrace, no jit-cache
+  population, bit-identical outputs (tests/test_warm_aot.py).
+
+Every artifact is stamped with the :func:`~.manifest.manifest_key`
+(host CPU fingerprint + jax/jaxlib versions). A stale stamp is loud:
+the artifact is **skipped and recompiled, never trusted** — jax.export
+payloads are toolchain-versioned and the XLA:CPU deserializer has
+segfaulted on machine-feature mismatches before (tests/conftest.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import log
+from . import manifest as wm
+
+
+class AOTUnsupported(RuntimeError):
+    """``jax.export`` cannot serialize this callable — callers fall back
+    to trace-and-compile into the persistent cache."""
+
+
+def export_jit(fn: Callable, *example_args: Any):
+    """Trace + lower ``jit(fn)`` at the example arguments' shapes and
+    return the ``jax.export.Exported`` (raises :class:`AOTUnsupported`
+    when the callable or backend cannot be exported)."""
+    import jax
+    from jax import export as jax_export
+
+    try:
+        return jax_export.export(jax.jit(fn))(*example_args)
+    except Exception as e:  # noqa: BLE001 — any export failure means fallback
+        raise AOTUnsupported(f"jax.export failed: {e!r}") from e
+
+
+def serialize(exported) -> bytes:
+    return bytes(exported.serialize())
+
+
+def deserialize(data: bytes):
+    from jax import export as jax_export
+
+    return jax_export.deserialize(bytearray(data))
+
+
+def _slug(name: str) -> str:
+    digest = hashlib.sha256(name.encode()).hexdigest()[:10]
+    safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in name)
+    return f"{safe[:80]}__{digest}"
+
+
+class ArtifactStore:
+    """A directory of serialized executables with loud invalidation.
+
+    Layout: ``<root>/<slug>.bin`` (the jax.export payload) +
+    ``<slug>.json`` (the environment key + name). ``load`` returns None
+    — after a warn log — for missing, stale-keyed, or undeserializable
+    artifacts; the caller recompiles. Never raises on bad disk state.
+    """
+
+    def __init__(self, root: str,
+                 key: Optional[Dict[str, object]] = None) -> None:
+        self.root = root
+        self.key = dict(key) if key is not None else wm.manifest_key()
+
+    def _paths(self, name: str) -> Tuple[str, str]:
+        s = _slug(name)
+        return (os.path.join(self.root, s + ".bin"),
+                os.path.join(self.root, s + ".json"))
+
+    def save(self, name: str, exported) -> str:
+        os.makedirs(self.root, exist_ok=True)
+        bin_path, meta_path = self._paths(name)
+        data = serialize(exported)
+        with open(bin_path, "wb") as f:
+            f.write(data)
+        with open(meta_path, "w") as f:
+            json.dump({"name": name, "key": self.key,
+                       "bytes": len(data)}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return bin_path
+
+    def load(self, name: str):
+        """The deserialized ``Exported`` (call via ``.call(*args)``), or
+        None. Version/fingerprint mismatches are the expected stale path
+        and log loudly — a silent wrong-machine deserialize is how AOT
+        segfaults happen."""
+        bin_path, meta_path = self._paths(name)
+        if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
+            return None
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warn("warm: unreadable AOT artifact meta — recompiling",
+                     artifact=name, error=repr(e))
+            return None
+        ok, reason = wm.key_matches(meta.get("key"), self.key)
+        if not ok:
+            log.warn("warm: STALE AOT artifact skipped — recompiling",
+                     artifact=name, reason=reason)
+            return None
+        try:
+            with open(bin_path, "rb") as f:
+                return deserialize(f.read())
+        except Exception as e:  # noqa: BLE001 — a corrupt payload must not kill boot
+            log.warn("warm: undeserializable AOT artifact — recompiling",
+                     artifact=name, error=repr(e))
+            return None
+
+    def names(self) -> List[str]:
+        out = []
+        try:
+            metas = [n for n in os.listdir(self.root) if n.endswith(".json")]
+        except OSError:
+            return []
+        for n in sorted(metas):
+            try:
+                with open(os.path.join(self.root, n)) as f:
+                    out.append(str(json.load(f)["name"]))
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+
+# -- the exportable-kernel registry ------------------------------------------
+#
+# Flagship jit entry points that are pure array→array (no host
+# callbacks, no Python protocol driving) and worth a direct AOT
+# artifact. Builders return (name, fn, example_args) for a given
+# manifest entry's dims; shapes matter, values do not.
+
+
+def _eddsa_kernels(B: int, q: int) -> List[Tuple[str, Callable, tuple]]:
+    import jax.numpy as jnp
+
+    from ..engine import eddsa_batch as eb
+
+    r64 = jnp.zeros((q, B, 64), jnp.uint8)
+    c64 = jnp.zeros((B, 64), jnp.uint8)
+    lamx = jnp.zeros((q,) + eb.scalars_to_limb_batch([0] * B).shape,
+                     jnp.int32)
+    return [
+        (f"eddsa.fused_sign_step__B{B}q{q}",
+         eb.fused_sign_step, (r64, c64, lamx)),
+        (f"eddsa.nonce_commitments__B{B}q{q}",
+         eb.nonce_commitments, (r64,)),
+    ]
+
+
+def kernels_for_entry(entry: "wm.WarmEntry") -> List[Tuple[str, Callable, tuple]]:
+    """The jax.export-able kernels behind a manifest entry (may be
+    empty — the trace-and-compile runner still covers the engine)."""
+    if entry.engine == "eddsa.sign":
+        return _eddsa_kernels(entry.B, int(entry.dims.get("q", "2")))
+    return []
+
+
+def warm_entry_artifacts(store: ArtifactStore, entry: "wm.WarmEntry"
+                         ) -> Dict[str, int]:
+    """Load-or-export every AOT kernel behind one manifest entry.
+    Returns {"loaded": n, "exported": n, "unsupported": n}."""
+    stats = {"loaded": 0, "exported": 0, "unsupported": 0}
+    for name, fn, args in kernels_for_entry(entry):
+        if store.load(name) is not None:
+            stats["loaded"] += 1
+            continue
+        try:
+            store.save(name, export_jit(fn, *args))
+            stats["exported"] += 1
+        except AOTUnsupported as e:
+            # expected fallback: the persistent cache still covers it
+            # mpclint: disable=MPF701 — `name` is the kernel's registry label (a shape-derived string), not nonce material
+            log.warn("warm: kernel not exportable — persistent cache "
+                     "fallback", kernel=name, error=str(e))
+            stats["unsupported"] += 1
+    return stats

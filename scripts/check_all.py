@@ -91,16 +91,28 @@ def main(argv=None) -> int:
             f"regenerate with scripts/mpcshape_surface.py and review the diff\n"
         )
 
+    # warmcheck off the same sweep's surface: the pre-warm work-list
+    # (mpcium_tpu.warm.manifest) must enumerate exactly knobs × buckets —
+    # a gap here means a serving shape the boot-time warm pass would
+    # silently never compile
+    from mpcium_tpu.warm.manifest import coverage_check, default_knobs
+
+    warm_problems = coverage_check(surface, default_knobs())
+    for prob in warm_problems:
+        out.write(f"WARM GAP: {prob}\n")
+
     elapsed = time.monotonic() - t0
     out.write(
         f"check_all: {len(files)} files in {elapsed:.2f}s — "
         f"{len(new)} new, {len(grandfathered)} grandfathered, "
         f"{len(stale)} stale, budget "
         f"{'DRIFTED' if drifted else 'in sync'}, surface "
-        f"{'DRIFTED' if surface_drifted else 'in sync'}\n"
+        f"{'DRIFTED' if surface_drifted else 'in sync'}, warm manifest "
+        f"{f'{len(warm_problems)} GAP(S)' if warm_problems else 'covered'}\n"
     )
     return 1 if (
         new or stale or parse_errors or drifted or surface_drifted
+        or warm_problems
     ) else 0
 
 

@@ -64,6 +64,11 @@ def test_drill_kill_resume():
     # the report carries how long resume took from respawn to signature
     assert report.resume_latency_s > 0
     assert any("bit-identical" in n for n in report.notes)
+    # ... and the warm-cache stats from the pre-respawn warm pass beside
+    # it (ISSUE 13: resume latency is recovery time, not compile wall)
+    assert set(report.warm) == {"warmed", "hits", "budget_s"}
+    assert report.warm["warmed"] >= 1
+    assert report.to_json()["warm"] == report.warm
 
 
 def test_drill_report_reproducible_from_seed():

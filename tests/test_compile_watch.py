@@ -138,6 +138,28 @@ def test_export_gauges_mirror(tmp_path):
     assert m.snapshot()["gauges"]["compile.ready"] == 1.0
 
 
+def test_seen_accessor(tmp_path):
+    compile_watch.set_ledger_dir(str(tmp_path))
+    assert not compile_watch.seen("e", "s")
+    compile_watch.finish(compile_watch.begin("e", "s"))
+    assert compile_watch.seen("e", "s")
+    assert not compile_watch.seen("e", "other")
+
+
+def test_unpredicted_in_summary_and_gauges(tmp_path):
+    """predicted:false entries — surface drift that escaped the static
+    gate — surface as a count in health and a compile.unpredicted gauge
+    (ISSUE 13 satellite)."""
+    compile_watch.set_ledger_dir(str(tmp_path))
+    compile_watch.finish(compile_watch.begin("gg18.sign", "B4|q2|mta=ot"))
+    compile_watch.finish(compile_watch.begin("no-such-engine", "B4"))
+    h = compile_watch.health_summary()
+    assert h["unpredicted"] == 1
+    m = MetricsRegistry()
+    compile_watch.export_gauges(m)
+    assert m.snapshot()["gauges"]["compile.unpredicted"] == 1.0
+
+
 def test_engine_hooks_ledger_real_sign(tmp_path):
     """End-to-end: a real (tiny) eddsa batch sign lands exactly one
     ledger entry per shape bucket, with repeat signs deduplicated."""

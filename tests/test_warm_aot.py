@@ -1,0 +1,116 @@
+"""AOT executable round-trip (mpcium_tpu/warm/aot.py): jax.export
+serialize → deserialize → call must be retrace-free and bit-identical
+to the jit path, and the ArtifactStore must loudly skip stale or
+corrupt artifacts instead of trusting them (ISSUE 13 satellite)."""
+import numpy as np
+import pytest
+
+from mpcium_tpu.warm import aot
+from mpcium_tpu.warm import manifest as wm
+
+pytestmark = pytest.mark.perf
+
+
+def _traced_fn():
+    """A tiny kernel with a Python-side trace counter: the counter only
+    ticks when jax re-traces the Python callable."""
+    import jax.numpy as jnp
+
+    traces = {"n": 0}
+
+    def fn(x):
+        traces["n"] += 1
+        return (x * 3 + 1) % 251, jnp.cumsum(x, axis=-1)
+
+    return fn, traces
+
+
+def test_roundtrip_retrace_free_and_bit_identical():
+    import jax
+    import jax.numpy as jnp
+
+    fn, traces = _traced_fn()
+    x = jnp.arange(24, dtype=jnp.uint32).reshape(2, 12)
+    want = jax.jit(fn)(x)
+
+    exported = aot.export_jit(fn, x)
+    traces_after_export = traces["n"]
+    data = aot.serialize(exported)
+    assert isinstance(data, bytes) and len(data) > 0
+
+    restored = aot.deserialize(data)
+    got1 = restored.call(x)
+    got2 = restored.call(x + 0)
+    # calling the deserialized executable never re-traces the Python fn
+    assert traces["n"] == traces_after_export
+    for w, g in zip(want, got1):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+    for a, b in zip(got1, got2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_unsupported_raises_typed_error():
+    def bad(x):
+        raise RuntimeError("untraceable")
+
+    with pytest.raises(aot.AOTUnsupported):
+        aot.export_jit(bad, np.zeros(2))
+
+
+def test_store_roundtrip_and_stale_invalidation(tmp_path):
+    import jax.numpy as jnp
+
+    fn, _ = _traced_fn()
+    x = jnp.arange(8, dtype=jnp.uint32)
+    exported = aot.export_jit(fn, x)
+
+    store = aot.ArtifactStore(str(tmp_path))
+    store.save("k/v:odd name", exported)
+    assert store.names() == ["k/v:odd name"]
+    loaded = store.load("k/v:odd name")
+    assert loaded is not None
+    np.testing.assert_array_equal(
+        np.asarray(loaded.call(x)[0]), np.asarray(exported.call(x)[0])
+    )
+    assert store.load("never saved") is None
+
+    # same dir read under a different environment key: every artifact is
+    # stale — skipped and recompiled, never trusted
+    other = aot.ArtifactStore(
+        str(tmp_path), key={"host": "beef", "jax": "0.0", "jaxlib": "0.0"}
+    )
+    assert other.load("k/v:odd name") is None
+
+
+def test_store_survives_corrupt_artifacts(tmp_path):
+    import jax.numpy as jnp
+
+    fn, _ = _traced_fn()
+    exported = aot.export_jit(fn, jnp.arange(4, dtype=jnp.uint32))
+    store = aot.ArtifactStore(str(tmp_path))
+    bin_path = store.save("c", exported)
+    with open(bin_path, "wb") as f:
+        f.write(b"garbage")
+    assert store.load("c") is None  # bad payload → recompile, not crash
+    meta = bin_path[: -len(".bin")] + ".json"
+    with open(meta, "w") as f:
+        f.write("{not json")
+    assert store.load("c") is None  # bad meta → recompile, not crash
+
+
+def test_eddsa_kernel_registry_exports_on_cpu(tmp_path):
+    """The flagship eddsa kernels export, persist, and reload for a real
+    manifest entry — the direct-AOT half of the warm pass."""
+    entry = wm.WarmEntry(engine="eddsa.sign", shape="B2|q2", B=2,
+                         scheme="eddsa", dims={"B": "2", "q": "2"})
+    store = aot.ArtifactStore(str(tmp_path))
+    stats = aot.warm_entry_artifacts(store, entry)
+    assert stats == {"loaded": 0, "exported": 2, "unsupported": 0}
+    # second pass: everything loads from disk, nothing re-exports
+    stats = aot.warm_entry_artifacts(store, entry)
+    assert stats == {"loaded": 2, "exported": 0, "unsupported": 0}
+    # engines without registered kernels contribute no artifacts (the
+    # persistent-cache fallback covers them)
+    other = wm.WarmEntry(engine="dkg.run", shape="B2|q2|ed25519", B=2,
+                         scheme="dkg", dims={})
+    assert aot.kernels_for_entry(other) == []

@@ -1,0 +1,96 @@
+"""The ISSUE 13 acceptance proof, CPU-backed: a fresh-process boot with
+a pre-populated warm cache answers its first batched sign with every
+serving-set entry classified ``cache: hit`` — zero ``miss``. Process 1
+is the real CLI (`scripts/prewarm.py`, the same walk `make prewarm` and
+the daemon run); process 2 is a cold Python process that only shares
+the cache directory on disk."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.perf
+
+_ROOT = Path(__file__).resolve().parents[1]
+
+# the serving set under proof: the drill/boot eddsa bucket
+_SCHEMES = "eddsa"
+_BUCKET = "2"
+
+_BOOT_SNIPPET = r"""
+import json, os, secrets, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from mpcium_tpu.warm import prewarm as pw
+pw.configure_cache(sys.argv[1])
+from mpcium_tpu.perf import compile_watch
+from mpcium_tpu.engine import eddsa_batch as eb
+
+t0 = time.monotonic()
+ids = [f"warm{i}" for i in range(3)]
+shares = eb.dealer_keygen_batch(2, ids, 1, rng=secrets)
+signer = eb.BatchedCoSigners(ids[:2], shares[:2], rng=secrets)
+sigs, ok = signer.sign([bytes([i]) * 32 for i in range(2)])
+assert ok.all(), "warm boot produced invalid signatures"
+print("WARMBOOT " + json.dumps({
+    "first_sign_s": round(time.monotonic() - t0, 2),
+    "entries": compile_watch.entries(),
+}))
+"""
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MPCIUM_TESTS_NO_CACHE", None)
+    return env
+
+
+def _run(cmd, timeout):
+    r = subprocess.run(
+        cmd, cwd=str(_ROOT), env=_env(), capture_output=True, text=True,
+        timeout=timeout,
+    )
+    assert r.returncode == 0, (
+        f"{cmd} failed rc={r.returncode}:\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}"
+    )
+    return r
+
+
+def test_fresh_process_boot_serves_from_cache(tmp_path):
+    cache = str(tmp_path / "cache")
+
+    # process 1: populate the cache through the real pre-warm CLI
+    r = _run(
+        [sys.executable, "scripts/prewarm.py", "--schemes", _SCHEMES,
+         "--buckets", _BUCKET, "--cache-dir", cache,
+         "--out", str(tmp_path)],
+        timeout=420,
+    )
+    report = json.loads((tmp_path / "WARM_MANIFEST.json").read_text())
+    assert report["totals"]["failed"] == 0
+    assert report["totals"]["skipped"] == 0
+    assert report["totals"]["warmed"] == report["totals"]["entries"] == 1
+    assert os.listdir(cache), "pre-warm wrote nothing to the cache"
+
+    # process 2: a cold boot sharing only the cache directory
+    r = _run([sys.executable, "-c", _BOOT_SNIPPET, cache], timeout=420)
+    line = next(
+        ln for ln in r.stdout.splitlines() if ln.startswith("WARMBOOT ")
+    )
+    boot = json.loads(line[len("WARMBOOT "):])
+    entries = boot["entries"]
+
+    # every serving-set compile in the fresh process deserialized from
+    # the warm cache: all hit, ZERO miss — the compile wall is gone
+    assert entries, "fresh boot ledgered no compiles at all"
+    assert all(e["cache"] == "hit" for e in entries), entries
+    served = [e for e in entries if e["engine"] == "eddsa.sign"]
+    assert len(served) == 1
+    assert served[0]["shape"] == f"B{_BUCKET}|q2"
+    assert served[0]["predicted"] is True
