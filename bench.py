@@ -479,9 +479,8 @@ def main() -> None:
             t0 = time.perf_counter()
             out = signer_ot.sign(digests)
             assert out["ok"].all()
-            record["gg18_ot_mta_sigs_per_sec"] = round(
-                B / (time.perf_counter() - t0), 3
-            )
+            checked_s = time.perf_counter() - t0
+            record["gg18_ot_mta_sigs_per_sec"] = round(B / checked_s, 3)
             record["gg18_ot_mta_batch"] = B
             # one phase-profiled pass for the host/device A/B split of
             # the OT phase: r2_mta_ot_host (worker-thread IKNP time:
@@ -515,6 +514,30 @@ def main() -> None:
             record["gg18_ot_mta_chunks"] = int(
                 phases_ot.get("r2_mta_ot_chunks", 1)
             )
+            # checks-on vs checks-off A/B (ISSUE 16): the timed run
+            # above paid the active-security check kernels (on by
+            # default); one more timed run under MPCIUM_OT_CHECKS=0
+            # isolates their cost. gg18_ot_checks_s is the per-batch
+            # overhead the KOS + Gilboa + consistency checks add — the
+            # number PERFORMANCE.md quotes for the passive escape
+            # hatch. Env is read per sign() call, so flip + restore.
+            prev_checks = os.environ.get("MPCIUM_OT_CHECKS")
+            os.environ["MPCIUM_OT_CHECKS"] = "0"
+            try:
+                out = signer_ot.sign(digests)  # compile the passive path
+                assert out["ok"].all()
+                t0 = time.perf_counter()
+                out = signer_ot.sign(digests)
+                assert out["ok"].all()
+                passive_s = time.perf_counter() - t0
+            finally:
+                if prev_checks is None:
+                    os.environ.pop("MPCIUM_OT_CHECKS", None)
+                else:
+                    os.environ["MPCIUM_OT_CHECKS"] = prev_checks
+            record["gg18_ot_checks_on_s"] = round(checked_s, 3)
+            record["gg18_ot_checks_off_s"] = round(passive_s, 3)
+            record["gg18_ot_checks_s"] = round(checked_s - passive_s, 3)
         except Exception as e:  # noqa: BLE001
             record["gg18_ot_mta_error"] = repr(e)
         finally:

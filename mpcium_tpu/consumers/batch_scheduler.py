@@ -391,6 +391,8 @@ class BatchSigningScheduler:
         self._m_age = m.histogram("scheduler.dispatch_age_s")
         self._m_takeover = m.counter("scheduler.deputy_takeover_total")
         self._m_fallback = m.counter("scheduler.fallback_total")
+        self._m_quarantined = m.counter("scheduler.quarantined_total")
+        self._m_repacked = m.counter("scheduler.repacked_total")
         self._m_e2e = m.histogram("scheduler.e2e_latency_s")
         self._m_decl_evict = m.counter("scheduler.declines_evicted_total")
         self._sub = transport.pubsub.subscribe(
@@ -754,6 +756,112 @@ class BatchSigningScheduler:
         log.warn("request shed", kind=e.kind, lane=e.lane, reason=reason,
                  wallet=getattr(msg, "wallet_id", "?"),
                  node=self.node.node_id)
+
+    def _absorb_cohort_abort(
+        self,
+        batch_id: str,
+        reqs: List[Tuple[wire.SignTxMessage, str]],
+        owned_set,
+        culprits,
+    ) -> None:
+        """Survivable identifiable abort (ISSUE 16): a batch died because
+        attributable protocol checks blamed specific lanes
+        (engine.abort.CohortAbort). Quarantine exactly those sessions —
+        one *retryable* ABORT event each, naming the culprit (party +
+        check), distinct idempotency key so a retry's result never
+        dedupes against the refusal — then re-pack the surviving
+        sessions onto fresh bucket-snapped sub-batches and run them to
+        completion. Deterministic across the quorum: every member saw
+        the same verdicts, derives the same survivor order and the same
+        child batch ids, so the re-packed sessions re-form without
+        another manifest round."""
+        by_lane: Dict[int, Tuple[str, str]] = {}
+        for lane, party, check in culprits:
+            by_lane.setdefault(int(lane), (str(party), str(check)))
+        survivors: List[Tuple[wire.SignTxMessage, str]] = []
+        for i, (msg, reply) in enumerate(reqs):
+            if i not in by_lane:
+                survivors.append((msg, reply))
+                continue
+            party, check = by_lane[i]
+            self._m_quarantined.inc()
+            reason = (
+                f"identifiable abort: party {party} failed OT check "
+                f"'{check}' (session {msg.tx_id}) — quarantined"
+            )
+            tracing.incident(
+                "cheater", node=self.node.node_id, tid=f"batch:{batch_id}",
+                req_kind="sign", reason=reason, party=party, check=check,
+            )
+            seq = next(self._shed_seq)
+            try:
+                ev = wire.SigningResultEvent(
+                    result_type=wire.RESULT_ERROR,
+                    wallet_id=msg.wallet_id, tx_id=msg.tx_id,
+                    network_internal_code=msg.network_internal_code,
+                    error_reason=reason, retryable=True,
+                )
+                self.transport.queues.enqueue(
+                    f"{wire.TOPIC_SIGNING_RESULT}.{msg.tx_id}",
+                    wire.canonical_json(ev.to_json()),
+                    idempotency_key=f"{msg.tx_id}-abort-{seq}",
+                )
+                if reply:
+                    # the refusal IS the answer; the client owns the
+                    # retry (fresh tx id, ideally a cleaner quorum)
+                    self.transport.pubsub.publish(reply, b"ERR")
+                if (msg.wallet_id, msg.tx_id) in owned_set:
+                    self.on_tx_released(msg.wallet_id, msg.tx_id)
+            except Exception as err:  # noqa: BLE001
+                log.warn("quarantine notification failed",
+                         wallet=msg.wallet_id, error=repr(err))
+            self._observe_e2e("sign", (msg.wallet_id, msg.tx_id))
+            log.warn("session quarantined (cohort abort)",
+                     batch=batch_id, wallet=msg.wallet_id, tx=msg.tx_id,
+                     party=party, check=check, node=self.node.node_id)
+        if not survivors:
+            return
+        # Bucket-snapped re-pack: pow-2 chunks exactly like _fire, so the
+        # retry batches land on prewarmed COMPILE_SURFACE shapes. Claims
+        # we hold for survivors transfer to the child runs via the same
+        # bump-then-forget handoff _inherit_covered uses — the refcount
+        # never touches zero, the consumer GC can't reap in between.
+        chunks: List[List[Tuple[wire.SignTxMessage, str]]] = []
+        rest = survivors
+        while rest:
+            n = floor_bucket(min(len(rest), self._chunk_cap))
+            chunks.append(rest[:n])
+            rest = rest[n:]
+        with self._lock:
+            if self._closed:
+                for msg, _r in survivors:
+                    if (msg.wallet_id, msg.tx_id) in owned_set:
+                        self.on_tx_released(msg.wallet_id, msg.tx_id)
+                return
+            for chunk in chunks:
+                for msg, _r in chunk:
+                    k = (msg.wallet_id, msg.tx_id)
+                    if k in owned_set:
+                        d = self._dedup_str("sign", k)
+                        self._batch_claims[d] = (
+                            self._batch_claims.get(d, 0) + 1
+                        )
+        for ci, chunk in enumerate(chunks):
+            self._m_repacked.inc()
+            child = f"{batch_id}r{ci}"
+            inherited = [
+                (m.wallet_id, m.tx_id) for m, _r in chunk
+                if (m.wallet_id, m.tx_id) in owned_set
+            ]
+            log.info("survivors re-packed after cohort abort",
+                     batch=batch_id, child=child, size=len(chunk),
+                     node=self.node.node_id)
+            threading.Thread(
+                target=self._run_guarded,
+                args=("sign", self._run_batch, child, chunk),
+                kwargs={"inherited": inherited},
+                name=f"bsign-{child}", daemon=True,
+            ).start()
 
     def _observe_e2e_locked(self, kind: str, ek: Tuple[str, str]) -> None:  # mpclint: holds=_lock
         t0 = self._intake_ts.pop((kind, ek[0], ek[1]), None)
@@ -1719,6 +1827,19 @@ class BatchSigningScheduler:
             _prune()
 
         def on_error(e):
+            # Identifiable abort (engine.abort.CohortAbort, duck-typed on
+            # .culprits so the distributed party can forward a peer's
+            # abort without importing the engine): quarantine exactly the
+            # blamed sessions and re-pack the survivors — never the
+            # whole-batch release below, which would retry the cheater
+            # alongside its victims forever.
+            culprits = getattr(e, "culprits", None)
+            if culprits:
+                self._absorb_cohort_abort(
+                    batch_id, reqs, owned_set, culprits
+                )
+                _prune()
+                return
             # retryable/protocol failure: emit nothing — durable redelivery
             # retries each request (possibly down the per-session path)
             log.warn("batch signing failed", batch=batch_id, error=str(e),

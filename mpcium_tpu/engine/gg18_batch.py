@@ -986,9 +986,10 @@ class GG18BatchCoSigners:
             if a != b
         ]
         # MtA implementation: "paillier" (default — the GG18 MtA with
-        # range proofs), "ot" (experimental OT-based Gilboa
-        # multiplication, protocol.ecdsa.mta_ot: no Paillier anywhere in
-        # signing, passive security — see SECURITY.md "OT-MtA"), or
+        # range proofs), "ot" (OT-based Gilboa multiplication,
+        # protocol.ecdsa.mta_ot: no Paillier anywhere in signing;
+        # KOS/DKLs-style checks with identifiable abort — see
+        # SECURITY.md "OT-MtA" for exact coverage), or
         # "none" (curve state only — no MtA contexts, cannot sign();
         # the multichip dryrun builds its sharding probe this way via
         # :meth:`curve_only` instead of hand-wiring ``__new__``)
@@ -1168,6 +1169,36 @@ class GG18BatchCoSigners:
             _mark("r2_mta_ot",
                   *[alpha_shares[(p[0], p[1], "w")] for p in self.pairs],
                   **ot_attrs)
+            # Identifiable abort (ISSUE 16): every leg ran its KOS /
+            # Gilboa / consistency checks inside run_multi; a blamed
+            # lane aborts the cohort with the offending (lane, party)
+            # named, so the scheduler can quarantine exactly those
+            # sessions and re-pack the survivors. Alice = the leg's
+            # receiver = party a (its choice bits are k_a); Bob = party
+            # b. A lane keeps its FIRST blame — a tampered extension
+            # garbles downstream pads, so later checks on the same lane
+            # are side effects, not independent evidence.
+            blamed: Dict[int, Tuple[str, str]] = {}
+            for (a, b) in self.pairs:
+                per_lane = self.ot_legs[(a, b)].check_blame()
+                if per_lane is None:
+                    continue
+                for lane, verdict in enumerate(per_lane):
+                    if verdict is None or lane in blamed:
+                        continue
+                    role, check = verdict
+                    blamed[lane] = (
+                        self.ids[a] if role == "alice" else self.ids[b],
+                        check,
+                    )
+            if blamed:
+                from .abort import CohortAbort
+
+                raise CohortAbort(
+                    [(lane, pid, check)
+                     for lane, (pid, check) in sorted(blamed.items())],
+                    engine="gg18.sign",
+                )
             out = self._finish_sign(
                 _mark, m, ok, k, gamma, Gamma, Gamma_comp,
                 g_commit, g_blind, alpha_shares, beta_shares,

@@ -4,8 +4,8 @@ The committee's value proposition is surviving partial failure; this
 package attacks the failure surface on purpose, reproducibly:
 
 - :mod:`.plan` — declarative, seed-deterministic fault plans (drop /
-  delay / duplicate / reorder / crash / partition rules with match
-  predicates and per-rule PRF streams);
+  delay / duplicate / reorder / crash / partition / tamper rules with
+  match predicates and per-rule PRF streams);
 - :mod:`.transport` — a :class:`~.transport.FaultyTransport` decorator
   over any :class:`~..transport.api.Transport` that applies the active
   plan on publish/deliver, plus the node crash switch;
@@ -23,5 +23,6 @@ from .plan import (  # noqa: F401
     named_plan,
     partition,
     reorder,
+    tamper,
 )
 from .transport import CrashSwitch, FaultStats, FaultyTransport  # noqa: F401

@@ -27,6 +27,14 @@ Drill catalog (expected outcome in parentheses):
   includes the corpse), the node respawns over its on-disk state, WAL
   replay re-claims the session and the SAME run completes with the
   bit-identical signature; the report carries ``resume_latency_s``.
+- ``cheater`` (caught-and-quarantined) — an active adversary corrupts
+  one PRF-chosen OT-MtA wire field in one batch lane mid-signing
+  (ISSUE 16); the KOS / Gilboa / consistency checks catch the
+  deviation and blame exactly the cheating party, the batch scheduler
+  quarantines that one session behind a retryable culprit-named ABORT
+  event and re-packs the survivors onto bucket-snapped sub-batches,
+  while live EdDSA traffic keeps signing on a real cluster; the report
+  carries ``culprit`` and ``survivors``.
 
 Reproducing a failed drill: the report carries ``seed`` and the full
 plan JSON; ``scripts/chaos_drill.py --plan <name> --seed <seed>`` reruns
@@ -34,10 +42,12 @@ the identical fault schedule (see plan.py's determinism contract).
 """
 from __future__ import annotations
 
+import hashlib
 import shutil
 import tempfile
 import threading
 import time
+import types
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -68,6 +78,11 @@ class DrillReport:
     # kill-resume: warm-cache stats from the pre-respawn warm pass
     # ({warmed, hits, budget_s} — mpcium_tpu.warm.prewarm.warm_for_drill)
     warm: dict = field(default_factory=dict)
+    # cheater: the blamed deviation ({session, lane, party, check, field})
+    culprit: dict = field(default_factory=dict)
+    # cheater: cohort completion stats after the quarantine
+    # ({submitted, quarantined, completed, pending, chunks})
+    survivors: dict = field(default_factory=dict)
     # merged cross-node Chrome-trace-event JSON (flight-recorder snapshot;
     # load in Perfetto / chrome://tracing)
     trace: dict = field(default_factory=dict)
@@ -86,6 +101,8 @@ class DrillReport:
             "error": self.error,
             "resume_latency_s": round(self.resume_latency_s, 3),
             "warm": self.warm,
+            "culprit": self.culprit,
+            "survivors": self.survivors,
             "trace": self.trace,
         }
 
@@ -570,12 +587,294 @@ def _drill_kill_resume(seed: int, scale: float):
         _close(cluster, root)
 
 
+class _DetRng:
+    """Deterministic CSPRNG stand-in for the cheater drill's synthetic
+    OT legs: a hash-counter stream, so the same seed draws identical
+    bytes in identical call order (mirrors the tier-1 OT pipeline
+    fixtures — the drill must be byte-reproducible from its seed)."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.ctr = 0
+
+    def token_bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += hashlib.sha256(
+                b"chaos-rng|%d|%d" % (self.seed, self.ctr)
+            ).digest()
+            self.ctr += 1
+        return bytes(out[:n])
+
+    def randbelow(self, n: int) -> int:
+        return int.from_bytes(self.token_bytes(40), "big") % n
+
+
+def _synth_ot_leg(seed: int):
+    """OTMtALeg with synthetic base-OT material satisfying the base-OT
+    postcondition (keysD[j] = k^{Δ_j}_j), skipping the curve ladders.
+    The tag is 8 bytes like the tier-1 pipeline fixtures' so the drill's
+    check kernels land in the SAME compile family (prefix lengths are
+    part of the jit key) instead of paying a second compile wall."""
+    import numpy as np
+
+    from ..protocol.ecdsa import mta_ot
+
+    rng = _DetRng(seed)
+    leg = mta_ot.OTMtALeg.__new__(mta_ot.OTMtALeg)
+    leg.tag = b"drill-|%d" % (seed % 10)
+    leg.rng = _DetRng(seed + 1000)
+    leg.ctr = 0
+    leg.k0 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.k1 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.delta = np.frombuffer(rng.token_bytes(mta_ot.KAPPA), np.uint8) & 1
+    leg.keysD = np.where(leg.delta[:, None].astype(bool), leg.k1, leg.k0)
+    leg.delta_packed = mta_ot._pack(leg.delta)
+    leg._delta_rows = np.nonzero(leg.delta)[0]
+    return leg
+
+
+def _drill_cheater(seed: int, scale: float):
+    """Active deviation caught, blamed and absorbed under live traffic.
+
+    Everything the cheater 'chooses' — which batch lane, which OT-MtA
+    wire field (hence which check must catch it and which party is to
+    blame), which byte, which xor mask — is a PRF draw from the named
+    ``cheater`` plan, so the identical deviation replays from (seed,
+    plan) alone. The corruption is injected protocol-level
+    (``OTMtALeg.set_tamper``: the OT rounds never cross the transport
+    in the in-process engine); the scheduler half drives the REAL
+    quarantine machinery (``_absorb_cohort_abort``: retryable
+    culprit-named ABORT event, claim handoff, bucket-snapped re-pack)
+    with a recording engine stub — the real GG18+OT engine raising
+    CohortAbort is covered by the slow tier (test_mta_ot.py). A live
+    3-node cluster keeps signing EdDSA traffic throughout."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..consumers.batch_scheduler import BatchSigningScheduler
+    from ..core import bignum as bn
+    from ..core.bignum import P256
+    from ..engine.abort import CohortAbort
+    from ..protocol.ecdsa import mta_ot
+    from ..transport.loopback import LoopbackFabric
+
+    plan = named_plan("cheater", seed)
+    rule = plan.rules[0]
+    notes: List[str] = []
+    B = 4  # tier-1 OT batch shape (shared compile family)
+    Q = mta_ot.Q
+
+    # the corruption surfaces an active cheater controls, and the check
+    # that MUST catch each (with the party its failure blames)
+    surfaces = (
+        ("U", None, "alice", mta_ot.CHECK_KOS),
+        ("kos_tbar", None, "alice", mta_ot.CHECK_KOS),
+        ("y1", 0, "bob", mta_ot.CHECK_GILBOA),
+        ("D", 1, "bob", mta_ot.CHECK_GILBOA),
+        ("B_pt", 0, "bob", mta_ot.CHECK_GILBOA),
+        ("Beta_pt", 1, "bob", mta_ot.CHECK_CONSISTENCY),
+    )
+    lane = int(plan._u(rule, b"cheat", 0, lane="lane") * B)
+    field_, set_idx, role, check = surfaces[
+        int(plan._u(rule, b"cheat", 0, lane="field") * len(surfaces))
+    ]
+    spec = {
+        "field": field_, "lane": lane,
+        "byte": int(plan._u(rule, b"cheat", 0, lane="byte") * 4096),
+        "xor": 1 + int(plan._u(rule, b"cheat", 0, lane="xor") * 255),
+    }
+    if set_idx is not None:
+        spec["set"] = set_idx
+    notes.append(
+        f"PRF-derived deviation: field={field_} lane={lane} "
+        f"byte={spec['byte']} xor={spec['xor']:#x} "
+        f"(must blame {role} via {check!r})"
+    )
+
+    cluster, root = _mk_cluster()
+    try:
+        _eddsa_keygen(cluster, "w-ch")
+        ev0 = _sign(cluster, "w-ch", "tx-ch0", timeout_s=60.0)
+        assert ev0.result_type == wire.RESULT_SUCCESS, ev0.error_reason
+        notes.append("keygen + baseline signature (live traffic up)")
+
+        # live traffic rides concurrently with the cheat-and-catch
+        live: dict = {}
+
+        def _live_signer():
+            try:
+                live["ev"] = _sign_retrying(
+                    cluster, "w-ch", "tx-ch-live", notes
+                )
+            except Exception as e:  # noqa: BLE001 — surfaced via the box
+                live["err"] = e
+
+        live_th = threading.Thread(target=_live_signer, daemon=True)
+        live_th.start()
+
+        # -- the deviation, and the checks catching it --------------------
+        def _limbs(vals):
+            return jnp.asarray(bn.batch_to_limbs(vals, P256))
+
+        r = _DetRng(seed + 31)
+        # nonzero Bob-side scalars: b ≡ 0 encodes the identity garbage
+        # (the 2^-256 caveat SECURITY.md documents) and would mis-frame
+        # the drill's blame assertion
+        a = [r.randbelow(Q - 1) + 1 for _ in range(B)]
+        g = [r.randbelow(Q - 1) + 1 for _ in range(B)]
+        w = [r.randbelow(Q - 1) + 1 for _ in range(B)]
+
+        leg = _synth_ot_leg(seed)
+        leg.set_tamper(spec)
+        leg.run_multi(_limbs(a), (_limbs(g), _limbs(w)))
+        blames = leg.check_blame()
+        caught = blames is not None and blames[lane] == (role, check)
+        misblamed = [
+            i for i, bl in enumerate(blames or [])
+            if i != lane and bl is not None
+        ]
+        notes.append(f"blame vector: {blames}")
+        if not caught or misblamed:
+            notes.append(
+                f"deviation NOT attributed cleanly (caught={caught}, "
+                f"misblamed lanes={misblamed})"
+            )
+            return ("undetected", False, notes, plan.to_json(),
+                    _merged_stats(cluster).to_json())
+
+        # -- the quarantine: real scheduler machinery ---------------------
+        survivors_expected = B - 1
+        completed: List[Tuple[str, List[str]]] = []
+        all_done = threading.Event()
+
+        class _RecordingScheduler(BatchSigningScheduler):
+            def _run_batch(self, batch_id, reqs, *mid, **kw):
+                completed.append((batch_id, [m.tx_id for m, _r in reqs]))
+                if sum(len(t) for _b, t in completed) >= survivors_expected:
+                    all_done.set()
+
+        fab = LoopbackFabric()
+        t = fab.transport()
+        events: List[wire.SigningResultEvent] = []
+        ev_lock = threading.Lock()
+
+        def _on_result(data: bytes) -> None:
+            import json as _json
+
+            with ev_lock:
+                events.append(
+                    wire.SigningResultEvent.from_json(_json.loads(data))
+                )
+
+        sub = t.queues.dequeue(f"{wire.TOPIC_SIGNING_RESULT}.*", _on_result)
+        sched = _RecordingScheduler(
+            types.SimpleNamespace(node_id="drill0", peer_ids=["drill0"]),
+            transport=t,
+        )
+        reqs = [
+            (wire.SignTxMessage(
+                key_type="ecdsa", wallet_id=f"w-co{i}",
+                network_internal_code="chaos", tx_id=f"tx-co{i}",
+                tx=b"cohort:%d" % i,
+            ), "")
+            for i in range(B)
+        ]
+        abort = CohortAbort([(lane, role, check)], engine="gg18.sign")
+        sched._absorb_cohort_abort("bdrill", reqs, frozenset(),
+                                   abort.culprits)
+        absorbed = all_done.wait(15.0)
+        fab.drain(timeout_s=15.0)
+        sub.unsubscribe()
+
+        quarantined = [
+            e for e in events if e.tx_id == reqs[lane][0].tx_id
+        ]
+        abort_named = (
+            len(quarantined) == 1
+            and quarantined[0].result_type == wire.RESULT_ERROR
+            and quarantined[0].retryable
+            and role in quarantined[0].error_reason
+            and check in quarantined[0].error_reason
+        )
+        survivor_txs = sorted(
+            tx for _b, txs in completed for tx in txs
+        )
+        expect_txs = sorted(
+            m.tx_id for i, (m, _r) in enumerate(reqs) if i != lane
+        )
+        chunks = [len(txs) for _b, txs in completed]
+        pow2 = all(n & (n - 1) == 0 for n in chunks)
+        notes.append(
+            f"quarantine: {len(quarantined)} retryable ABORT event(s) "
+            f"naming ({role}, {check!r}); survivors re-packed into "
+            f"pow-2 chunks {chunks}"
+        )
+        invariant = (
+            absorbed and survivor_txs == expect_txs
+            and len(survivor_txs) + len(quarantined) == B
+        )
+        notes.append(
+            f"cohort invariant: submitted={B} = completed="
+            f"{len(survivor_txs)} + quarantined={len(quarantined)}, "
+            f"pending={B - len(survivor_txs) - len(quarantined)}"
+        )
+
+        # -- survivors complete: honest re-run at the same batch shape ----
+        leg.set_tamper(None)
+        out2 = leg.run_multi(_limbs(a), (_limbs(g), _limbs(w)))
+        blames2 = leg.check_blame()
+        clean = blames2 is not None and all(bl is None for bl in blames2)
+        shares_ok = True
+        for (al, be), b_ints in zip(out2, (g, w)):
+            ai = bn.batch_from_limbs(np.asarray(al), P256)
+            bi = bn.batch_from_limbs(np.asarray(be), P256)
+            shares_ok &= all(
+                (ai[i] + bi[i]) % Q == a[i] * b_ints[i] % Q
+                for i in range(B)
+            )
+        notes.append(
+            f"honest re-run: checks clean={clean}, MtA shares "
+            f"valid={shares_ok}"
+        )
+
+        live_th.join(90.0)
+        live_ok = (
+            "ev" in live
+            and live["ev"].result_type == wire.RESULT_SUCCESS
+        )
+        notes.append(f"live traffic kept signing throughout: {live_ok}")
+
+        ok = (caught and not misblamed and abort_named and invariant
+              and clean and shares_ok and live_ok)
+        culprit = {
+            "session": reqs[lane][0].tx_id, "lane": lane,
+            "party": role, "check": check, "field": field_,
+        }
+        survivors = {
+            "submitted": B, "quarantined": len(quarantined),
+            "completed": len(survivor_txs),
+            "pending": B - len(survivor_txs) - len(quarantined),
+            "chunks": chunks if pow2 else chunks + ["NOT-POW2"],
+        }
+        return ("caught-and-quarantined" if ok else "leaked", ok, notes,
+                plan.to_json(), _merged_stats(cluster).to_json(),
+                {"culprit": culprit, "survivors": survivors})
+    finally:
+        _close(cluster, root)
+
+
 DRILLS: Dict[str, Tuple[Callable, str]] = {
     "node-crash": (_drill_node_crash, "recovered"),
     "drop-jitter": (_drill_drop_jitter, "success"),
     "broker-failover": (_drill_broker_failover, "success"),
     "partition": (_drill_partition, "loud-failure-then-recovery"),
     "kill-resume": (_drill_kill_resume, "resumed"),
+    "cheater": (_drill_cheater, "caught-and-quarantined"),
 }
 
 

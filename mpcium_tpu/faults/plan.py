@@ -2,9 +2,9 @@
 
 A plan is an ordered list of rules — ``drop(p=0.1, topic="sign:*")``,
 ``delay(ms=(50, 200))``, ``duplicate()``, ``reorder()``,
-``crash_node("node2", at_round="r1")``, ``partition(["node1"], 5.0)`` —
-each with match predicates over topic / observing node / channel /
-direction.
+``crash_node("node2", at_round="r1")``, ``partition(["node1"], 5.0)``,
+``tamper(p=1.0, topic="bsign:*", mode="flip")`` — each with match
+predicates over topic / observing node / channel / direction.
 
 Determinism contract: every probabilistic decision is a pure function
 ``PRF(seed, rule_id, message_key, occurrence)`` where ``message_key``
@@ -62,8 +62,8 @@ class MsgEvent:
 @dataclass
 class Rule:
     """One fault rule. ``kind`` ∈ {drop, delay, duplicate, reorder,
-    crash_node, partition}; the constructor helpers below are the
-    intended spelling."""
+    crash_node, partition, tamper}; the constructor helpers below are
+    the intended spelling."""
 
     kind: str
     p: float = 1.0
@@ -76,6 +76,7 @@ class Rule:
     at_round: str = ""  # crash_node: fire when this round leaves the node
     start_s: float = 0.0  # partition: window start (from activate())
     duration_s: Optional[float] = None  # partition: None = until heal()
+    mode: str = ""  # tamper: flip | truncate | replay ("" pre-tamper plans)
     rule_id: str = ""  # stable per-plan id (assigned by FaultPlan)
 
     def matches(self, ev: MsgEvent) -> bool:
@@ -149,6 +150,35 @@ def crash_node(node: str, at_round: str = "", topic: str = "*") -> Rule:
                 direction="out")
 
 
+TAMPER_MODES = ("flip", "truncate", "replay")
+
+
+def tamper(p: float = 1.0, topic: str = "*", node: str = "*",
+           channel: str = "*", direction: str = "out",
+           mode: str = "flip") -> Rule:
+    """Corrupt matching payloads in transit — the active-adversary
+    drill (ISSUE 16: the protocol's KOS/Gilboa/consistency checks must
+    catch, blame and survive this). Modes, all under the same
+    PRF(seed, rule, msg, occurrence) contract:
+
+    - ``flip``     XOR one PRF-chosen byte with a PRF-chosen nonzero
+                   value (bit-level corruption a checksum-free wire
+                   would pass through);
+    - ``truncate`` cut the payload to a PRF-chosen strict prefix
+                   (mid-message connection loss / short write);
+    - ``replay``   substitute the rule's previously captured matching
+                   payload (stale-message injection; the first match is
+                   captured and passed through unmodified — under
+                   concurrent senders "previous" follows arrival order,
+                   so transcript-determinism drills run serial traffic).
+    """
+    if mode not in TAMPER_MODES:
+        raise ValueError(f"tamper mode {mode!r}: expected one of "
+                         f"{TAMPER_MODES}")
+    return Rule(kind="tamper", p=p, topic=topic, node=node, channel=channel,
+                direction=direction, mode=mode)
+
+
 def partition(nodes: Sequence[str], duration_s: Optional[float] = None,
               start_s: float = 0.0) -> Rule:
     """Isolate ``nodes`` from everyone (drop all their traffic, both
@@ -181,6 +211,7 @@ class FaultPlan:
         self._epoch: Optional[float] = None
         self._healed = False
         self._fired: set = set()  # crash rules are one-shot events
+        self._replay: Dict[str, bytes] = {}  # tamper(replay) capture cells
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -224,6 +255,29 @@ class FaultPlan:
     def delay_ms(self, rule: Rule, key: bytes, occ: int) -> float:
         lo, hi = rule.ms
         return lo + self._u(rule, key, occ, lane="delay") * (hi - lo)
+
+    def tamper_bytes(self, rule: Rule, key: bytes, occ: int, data: bytes,
+                     triggered: bool = True) -> bytes:
+        """The corrupted payload for one tamper judgement. Pure in
+        (seed, rule, key, occ, data) for flip/truncate; replay reads and
+        refreshes the rule's capture cell (every MATCH captures, so the
+        substituted bytes are the previously observed matching payload).
+        With ``triggered=False`` only the replay capture side effect
+        runs and ``data`` passes through unchanged."""
+        if rule.mode == "replay":
+            with self._lock:
+                prev = self._replay.get(rule.rule_id)
+                self._replay[rule.rule_id] = data
+            return prev if (triggered and prev is not None) else data
+        if not triggered or not data:
+            return data
+        if rule.mode == "truncate":
+            cut = int(self._u(rule, key, occ, lane="cut") * len(data))
+            return data[:min(cut, len(data) - 1)]
+        # flip (the default): one byte, nonzero mask — always corrupts
+        idx = int(self._u(rule, key, occ, lane="idx") * len(data)) % len(data)
+        mask = 1 + int(self._u(rule, key, occ, lane="val") * 255)
+        return data[:idx] + bytes([data[idx] ^ mask]) + data[idx + 1:]
 
     # -- queries the transport asks ----------------------------------------
 
@@ -328,6 +382,14 @@ def named_plan(name: str, seed: int,
             rules.append(reorder(p=0.3, topic=t, channel="pubsub",
                                  window_ms=50.0 * scale))
         return FaultPlan(seed, rules)
+    if name == "cheater":
+        # one active deviation inside the OT-MtA rounds of a batched
+        # signing cohort (ISSUE 16). The OT wire rounds never cross the
+        # transport in the in-process engine, so the drill injects the
+        # corruption protocol-level (mta_ot.OTMtALeg.set_tamper) with
+        # lane/field/byte all PRF-derived from THIS plan's seed — the
+        # rule records the intent and keys the derivation.
+        return FaultPlan(seed, [tamper(p=1.0, topic="bsign:*", mode="flip")])
     if name == "batch-chaos":
         # the load-soak plan: jitter on every batched-session round plus
         # acked-unicast losses (the sender's retry budget absorbs them —

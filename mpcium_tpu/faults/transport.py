@@ -18,6 +18,12 @@ Semantics per channel:
 - **durable queue** — drop loses the enqueue, duplicate re-enqueues
   (drilling Nats-Msg-Id idempotency), delay defers it.
 
+Tamper rules (active adversary, ISSUE 16) corrupt the payload on any
+channel — outbound before delivery, inbound before the handler — via
+:meth:`~.plan.FaultPlan.tamper_bytes` (PRF-chosen byte flip, truncate,
+or replay substitution); the delivered bytes differ, the schedule log
+records the judgement.
+
 The :class:`CrashSwitch` gives SIGKILL semantics: once flipped, the node
 emits nothing and hears nothing (its subscriptions stay registered, like
 a dead process's socket buffers) until :meth:`CrashSwitch.restore`.
@@ -250,6 +256,24 @@ class FaultyTransport:
                 total += d_ms / 1000.0
         return total
 
+    def _roll_tamper(self, ev: MsgEvent) -> Optional[bytes]:
+        """The corrupted payload when a tamper rule fires, else None.
+        Rolled on the ORIGINAL bytes (the message key and occurrence
+        stream never depend on what an earlier tamper rule did), applied
+        cumulatively when several rules fire."""
+        data = ev.data
+        hit = False
+        for r in self.plan.matching(ev, ("tamper",)):
+            u, key, occ = self.plan.roll(r, ev)
+            out = self.plan.tamper_bytes(r, key, occ, data,
+                                         triggered=u < r.p)
+            if out != data:
+                self.stats.record(r.rule_id, "tamper", ev, key, occ,
+                                  mode=r.mode, nbytes=len(out))
+                data = out
+                hit = True
+        return data if hit else None
+
     def _roll_duplicate(self, ev: MsgEvent) -> bool:
         dup = False
         for r in self.plan.matching(ev, ("duplicate",)):
@@ -325,7 +349,8 @@ class FaultyTransport:
             d = self._sample_delay_s(ev)
             if d > 0:
                 time.sleep(d)
-            return handler(data)
+            t = self._roll_tamper(ev)
+            return handler(data if t is None else t)
 
         return wrapped
 
@@ -359,11 +384,13 @@ class _FaultyPubSub(PubSub):
         if ft._roll_drop(ev) is not None:
             ft._maybe_crash_after(ev)
             return
+        t = ft._roll_tamper(ev)
+        payload = data if t is None else t
 
         def emit():
-            ft.inner.pubsub.publish(topic, data)
+            ft.inner.pubsub.publish(topic, payload)
             if ft._roll_duplicate(ev):
-                ft.inner.pubsub.publish(topic, data)
+                ft.inner.pubsub.publish(topic, payload)
 
         if ft._reorder(ev, emit):
             ft._maybe_crash_after(ev)
@@ -422,12 +449,15 @@ class _FaultyDirect(DirectMessaging):
         d = ft._sample_delay_s(ev)
         if d > 0:
             time.sleep(d)
+        t = ft._roll_tamper(ev)
+        payload = data if t is None else t
         for attempt in range(self.DROP_ATTEMPTS):
             if ft._roll_drop(ev) is None:
-                ft.inner.direct.send(topic, data, timeout_s=timeout_s)
+                ft.inner.direct.send(topic, payload, timeout_s=timeout_s)
                 if ft._roll_duplicate(ev):
                     try:
-                        ft.inner.direct.send(topic, data, timeout_s=timeout_s)
+                        ft.inner.direct.send(topic, payload,
+                                             timeout_s=timeout_s)
                     except TransportError:
                         pass  # duplicate delivery is best-effort
                 ft._maybe_crash_after(ev)
@@ -463,14 +493,16 @@ class _FaultyQueue(MessageQueue):
             )
         if ft._roll_drop(ev) is not None:
             return  # lost write — at-least-once producers re-send
+        t = ft._roll_tamper(ev)
+        payload = data if t is None else t
 
         def emit():
-            ft.inner.queues.enqueue(topic, data, idempotency_key)
+            ft.inner.queues.enqueue(topic, payload, idempotency_key)
             if ft._roll_duplicate(ev):
                 # re-enqueue under the SAME idempotency key: the dedup
                 # window must absorb it (and without a key, consumers
                 # must tolerate the duplicate)
-                ft.inner.queues.enqueue(topic, data, idempotency_key)
+                ft.inner.queues.enqueue(topic, payload, idempotency_key)
 
         d = ft._sample_delay_s(ev)
         if d > 0:

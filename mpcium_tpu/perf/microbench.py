@@ -18,6 +18,13 @@ layers a PR can silently slow down without touching a kernel:
   of the ops.hash_suite device kernels the OT-MtA extension rides
   (ISSUE 11) — compile happens once in the warmup call, so the samples
   measure dispatch + execute, which is what a regression would slow.
+- ``ot_kos_check_device``: warm-dispatch cost of the KOS correlation
+  check pair (tags + verify) the active-security OT-MtA runs per
+  extension (ISSUE 16). One lane: the per-extension fixed cost every
+  checked signing batch pays. The Gilboa/consistency kernels are
+  deliberately NOT micro-benched — their shared secp-ladder jit units
+  cost ~70 s of cold compile on a bare CPU host, blowing the <30 s
+  budget; bench.py's ``gg18_ot_checks_s`` A/B covers them end to end.
 
 No TOP-LEVEL jax import: perfcheck must run in <30 s on a bare CPU
 host, so the device rows import jax lazily inside the bench body and
@@ -181,6 +188,42 @@ def ot_transpose_device(samples: int = DEFAULT_SAMPLES) -> List[float]:
     return _timed_samples(body, samples)
 
 
+def ot_kos_check_device(samples: int = DEFAULT_SAMPLES) -> List[float]:
+    """Warm dispatch of the KOS correlation-check kernels (mta_ot):
+    Alice's χ-tag opening plus Bob's χ·Q == t̄ ⊕ x̄⊗Δ verify at one
+    batch lane (M = 256 OTs, κ = 128). The warmup call pays the
+    one-time compile; samples measure dispatch + execute."""
+    import numpy as np
+
+    from ..protocol.ecdsa import mta_ot
+
+    def blob(tag: bytes, n: int) -> bytes:
+        out = bytearray()
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.sha256(b"perfkos|%s|%d" % (tag, ctr)).digest()
+            ctr += 1
+        return bytes(out[:n])
+
+    kappa, m = mta_ot.KAPPA, mta_ot.NBITS  # one lane
+    rows_a = np.frombuffer(
+        blob(b"ra", m * kappa // 8), np.uint8).reshape(m, kappa // 8)
+    rows_b = np.frombuffer(
+        blob(b"rb", m * kappa // 8), np.uint8).reshape(m, kappa // 8)
+    x_bits = np.frombuffer(blob(b"xb", m), np.uint8) & 1
+    delta = np.frombuffer(blob(b"dl", kappa), np.uint8) & 1
+    U = np.frombuffer(blob(b"uu", kappa * 32), np.uint8).reshape(kappa, 32)
+    pref = mta_ot._fs_prefixes(b"perfkos|", b"kos")
+
+    def body() -> None:
+        xbar, tbar = mta_ot._k_kos_tags(rows_a, x_bits, U, *pref)
+        mta_ot._k_kos_verify(
+            rows_b, delta, U, xbar, tbar, *pref
+        ).block_until_ready()
+
+    return _timed_samples(body, samples)
+
+
 ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
     "field_mulmod": field_mulmod,
     "sha256_block": sha256_block,
@@ -189,6 +232,7 @@ ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
     "span_overhead": span_overhead,
     "prg_expand_device": prg_expand_device,
     "ot_transpose_device": ot_transpose_device,
+    "ot_kos_check_device": ot_kos_check_device,
 }
 
 

@@ -36,16 +36,33 @@ Construction:
   SCHEDULING ONLY — transcripts and shares are bit-identical to the
   serial three-round composition (tests/test_mta_ot_pipeline.py).
 
-SECURITY (be explicit — this is why the flag defaults off): as
-implemented this provides passive (semi-honest) security. The IKNP
-extension lacks the KOS15 consistency check and the Gilboa payloads lack
-the DKLs18/19 encoding-and-check layer, so an ACTIVELY deviating party
-can cause incorrect outputs; incorrectness is caught by the engine's
-in-protocol ECDSA verification (no bad signature is ever released), but
-REPEATED induced aborts can leak bits of the honest party's nonce share
-(selective-failure), which the default Paillier+range-proof path
-prevents. See SECURITY.md "OT-MtA (experimental)". Enable with
-MPCIUM_MTA=ot.
+SECURITY (active checks, ON by default — MPCIUM_OT_CHECKS=0 is the A/B
+escape hatch): every extension carries three statistically-sound check
+layers, all vmapped device math on the ops.hash_suite primitives:
+
+* **KOS-style correlation check** (verifier: Bob) — a Fiat–Shamir
+  challenge χ ∈ GF(2)^{κ×256} per lane, derived from a Merkle digest of
+  the lane's U columns, binds Alice's extension matrix to ONE consistent
+  choice-bit vector: Alice ships x̄ = χ·x and t̄ = χ·T with round 1, Bob
+  checks χ·Q = t̄ ⊕ x̄⊗Δ. Soundness 2^-κ; failure blames Alice.
+* **Gilboa ψ-encoding check** (verifier: Alice) — DKLs18-style: weights
+  ψ_i ∈ Z_q are FS-derived from a Merkle digest of Bob's masked payload
+  rows, fixed AFTER the payloads; Bob ships D = Σψ_i·z_i and B = b·G,
+  Alice checks (Σψ_i·m_sel,i)·G == D·G + (Σ_{x_i=1}ψ_i·2^i)·B, so any
+  payload pair inconsistent with SOME (z, b) encoding on a selected
+  branch is caught. Failure blames Bob.
+* **MtA output consistency** (verifier: Alice) — Bob ships β·G; Alice
+  checks α·G + β·G == a·(b·G), pinning the advertised output shares to
+  the checked encoding. Failure blames Bob.
+
+Verdicts land per lane in ``check_verdicts`` (see ``check_blame``), so
+the batch engine can attribute an identifiable abort to the offending
+(session, party) instead of killing the cohort. Residual gaps — a
+lying verifier can still FRAME the other party (no publicly verifiable
+transcript), each aborted attempt leaks ≤ 1 chosen predicate bit of the
+honest input (selective failure), and output substitution AFTER a clean
+MtA is caught by GG18 phase 5, not here — are scoped in SECURITY.md
+"OT-MtA". Enable the path with MPCIUM_MTA=ot.
 
 Reference correspondence: replaces the tss-lib MtA
 (SURVEY.md §2.3; reference pkg/mpc/ecdsa_signing_session.go drives
@@ -84,8 +101,12 @@ Q = hm.SECP_N
 # mixed-version parties derive unrelated pads instead of silently
 # unmasking garbage; the explicit `v` field in the round messages turns
 # that into a LOUD contract failure (see bob_round2_multi /
-# alice_round3_multi). SECURITY.md "OT-MtA" documents the break.
-OT_WIRE_VERSION = 2
+# alice_round3_multi). v3: active-security check messages ride the
+# rounds — alice_round1 gains the KOS tags (`kos_xbar`, `kos_tbar`),
+# each bob_round2 payload set gains the Gilboa/consistency openings
+# (`D`, `B_pt`, `Beta_pt`) — and the version-stamped tag again firewalls
+# the PRF domains of mixed-version quorums. SECURITY.md "OT-MtA".
+OT_WIRE_VERSION = 3
 
 # One background worker is the whole double-buffer: run_multi enqueues
 # every chunk's host-side extension work (PRG expansion, bit-matrix
@@ -133,6 +154,16 @@ def device_path_enabled() -> bool:
     (alice_round1 / bob_round2_multi / alice_round3_multi) and the
     transcript oracle; set MPCIUM_OT_DEVICE=0 to force it in-process."""
     return os.environ.get("MPCIUM_OT_DEVICE", "1") != "0"
+
+
+def ot_checks_enabled() -> bool:
+    """MPCIUM_OT_CHECKS gates the active-security check layers (KOS
+    correlation / Gilboa ψ-encoding / output consistency — module
+    docstring). Default ON; =0 is the A/B escape hatch for measuring
+    the cost of active security (bench.py gg18_ot_checks_s) and MUST be
+    set identically quorum-wide: a checks-on party rejects a checks-off
+    peer's round messages loudly (missing check fields)."""
+    return os.environ.get("MPCIUM_OT_CHECKS", "1") != "0"
 
 
 def _hash_rows(prefix: bytes, rows: np.ndarray) -> np.ndarray:
@@ -345,7 +376,10 @@ def _ot_chunk_device(
     every invocation); r_bits_c (Mc,); r_packed_c (Mc/8,); m0s/m1s
     (S, Bc, NBITS, 32); blk_off/m_off traced uint32 (the chunk's PRG
     block / global OT index origin). → (alphas (S, Bc, n), U (κ, Bc·32),
-    y0s, y1s (S, Mc, 32))."""
+    y0s, y1s (S, Mc, 32), rows_a, rows_b (Mc, κ/8), sels (S, Mc, 32) —
+    the row matrices and unmasked selections feed the active-security
+    check pass (`_verify_inprocess`); they already exist inside the
+    fused kernel, so emitting them costs copies, not compute)."""
     Bc = r_packed_c.shape[0] // 32
     Mc = r_bits_c.shape[0]
     t0 = hs.prg_expand_core(k0, prg_prefix, Bc, blk_off)
@@ -359,7 +393,7 @@ def _ot_chunk_device(
         jnp.asarray(m_off, jnp.uint32) + jnp.arange(Mc, dtype=jnp.uint32)
     )
     sel_bits = r_bits_c.astype(bool)[:, None]
-    alphas, y0s, y1s = [], [], []
+    alphas, y0s, y1s, sels = [], [], [], []
     for s in range(pad_prefixes.shape[0]):
         pref = pad_prefixes[s]
         pad_a = hs.pad_hash_core(pref, rows_a, idx_le)
@@ -373,7 +407,344 @@ def _ot_chunk_device(
         )
         y0s.append(y0)
         y1s.append(y1)
-    return jnp.stack(alphas), U, jnp.stack(y0s), jnp.stack(y1s)
+        sels.append(sel)
+    return (
+        jnp.stack(alphas), U, jnp.stack(y0s), jnp.stack(y1s),
+        rows_a, rows_b, jnp.stack(sels),
+    )
+
+
+# ---------------------------------------------------------------------------
+# active-security checks (module docstring "SECURITY"): KOS correlation,
+# Gilboa ψ-encoding, MtA output consistency — all pure device math
+# (batched SHA-256, GF(2) algebra as integer matmuls, scalar-ring sums,
+# curve ladders), so a 4096-lane cohort is checked in a handful of
+# dispatches.
+# ---------------------------------------------------------------------------
+
+CHECK_KOS = "kos"                  # verifier Bob; failure blames Alice
+CHECK_GILBOA = "gilboa"            # verifier Alice; failure blames Bob
+CHECK_CONSISTENCY = "consistency"  # verifier Alice; failure blames Bob
+
+
+def _fs_prefixes(tag: bytes, kind: bytes, set_idx: Optional[int] = None):
+    """Fiat–Shamir hash-domain prefixes (leaf / merkle-node / prg) for
+    one check family, as traced uint8 arrays — tags embed the extension
+    counter, so static operands would recompile every invocation."""
+    base = b"mpcium-ot-" + kind + b"|" + tag
+    if set_idx is not None:
+        base += b"|s%d" % set_idx
+    return tuple(
+        jnp.asarray(np.frombuffer(base + sfx, np.uint8))
+        for sfx in (b"|leaf", b"|node", b"|prg")
+    )
+
+
+def _pt_encode(p) -> jnp.ndarray:
+    """Batch points → SEC1 *uncompressed* bytes (..., 65). Uncompressed
+    on purpose: the verifier's decode then needs only the curve
+    equation, not the Tonelli square-root ladder a compressed decode
+    would drag into every check kernel's one-time compile."""
+    F = sp.secp256k1_field()
+    zi = F.inv(p.Z)
+    x = F.canonical(F.mul(p.X, zi))
+    y = F.canonical(F.mul(p.Y, zi))
+    tag = jnp.full(x.shape[:-1] + (1,), 4, jnp.uint8)
+    return jnp.concatenate(
+        [tag, sp.pack_be_32(x), sp.pack_be_32(y)], axis=-1
+    )
+
+
+def _pt_decode(b: jnp.ndarray):
+    """SEC1 uncompressed (..., 65) → (SecpPointJ, ok mask). Bad
+    encodings (wrong tag, coords ≥ p, off-curve — anything a cheater
+    could substitute) yield ok=False with a valid-shape point; callers
+    fold the mask into the check verdict."""
+    F = sp.secp256k1_field()
+    tag = b[..., 0].astype(jnp.int32)
+    x = bn.bytes_to_limbs_le(
+        jnp.flip(b[..., 1:33], axis=-1), sp.PROF, sp.PROF.n_limbs
+    )
+    y = bn.bytes_to_limbs_le(
+        jnp.flip(b[..., 33:65], axis=-1), sp.PROF, sp.PROF.n_limbs
+    )
+    p_l = jnp.broadcast_to(
+        jnp.asarray(bn.to_limbs(hm.SECP_P, sp.PROF)), x.shape
+    )
+    on_curve = F.eq(
+        F.square(y),
+        F.add(F.mul(F.square(x), x), F.const(7, x.shape[:-1])),
+    )
+    ok = (
+        (tag == 4)
+        & (bn.compare(x, p_l) < 0)
+        & (bn.compare(y, p_l) < 0)
+        & on_curve
+    )
+    one = jnp.broadcast_to(jnp.asarray(bn.to_limbs(1, sp.PROF)), x.shape)
+    return sp.SecpPointJ(x, y, one), ok
+
+
+def _merkle_root(leaves: jnp.ndarray, node_prefix: jnp.ndarray) -> jnp.ndarray:
+    """(..., L, 32) digests, L a power of two → (..., 32) Merkle root
+    via log2(L) batched pair-hash levels. A sequential chain would
+    unroll one SHA compression per leaf into the trace; the tree keeps
+    the trace logarithmic and every level a single batched dispatch."""
+    P = node_prefix.shape[0]
+    while leaves.shape[-2] > 1:
+        half = leaves.shape[-2] // 2
+        pairs = leaves.reshape(leaves.shape[:-2] + (half, 64))
+        msg = jnp.concatenate(
+            [jnp.broadcast_to(node_prefix, pairs.shape[:-1] + (P,)), pairs],
+            axis=-1,
+        )
+        leaves = hs.sha256_core(msg, P + 64)
+    return leaves[..., 0, :]
+
+
+def _chi_bits(U: jnp.ndarray, leaf_p, node_p, prg_p) -> jnp.ndarray:
+    """Per-lane KOS challenge χ ∈ GF(2)^{κ×256}, FS-derived from the
+    lane's own U columns: per-row leaf digests → Merkle root → PRG
+    expansion. Both parties compute this from the U that crossed the
+    wire, so a tampered U yields a DIFFERENT challenge on Bob's side
+    and the tag equation fails with overwhelming probability.
+    U (κ, B·32) packed → (B, κ, 256) int32 0/1."""
+    Bn = U.shape[1] // 32
+    lanes = jnp.moveaxis(U.reshape(KAPPA, Bn, 32), 1, 0)  # (B, κ, 32)
+    r_le = hs.le16_bytes(jnp.arange(KAPPA, dtype=jnp.uint32))
+    P = leaf_p.shape[0]
+    msg = jnp.concatenate(
+        [
+            jnp.broadcast_to(leaf_p, (Bn, KAPPA, P)),
+            lanes,
+            jnp.broadcast_to(r_le[None], (Bn, KAPPA, 2)),
+        ],
+        axis=-1,
+    )
+    root = _merkle_root(hs.sha256_core(msg, P + 34), node_p)  # (B, 32)
+    raw = hs.prg_expand_core(root, prg_p, KAPPA, jnp.uint32(0))
+    return hs.unpack_bits_core(raw.reshape(Bn, KAPPA, 32)).astype(jnp.int32)
+
+
+@jax.jit
+def _k_kos_tags(rows_a, x_bits, U, leaf_p, node_p, prg_p):
+    """Alice's KOS opening: x̄ = χ·x, t̄ = χ·T over GF(2), computed as
+    integer matmuls masked to the low bit (MXU-friendly; values stay
+    ≤ 256). rows_a (M, κ/8) packed, x_bits (M,) 0/1, U (κ, B·32) →
+    (x̄ packed (B, κ/8), t̄ packed (B, κ, κ/8))."""
+    Bn = x_bits.shape[0] // NBITS
+    chi = _chi_bits(U, leaf_p, node_p, prg_p)  # (B, κ, 256)
+    xb = x_bits.reshape(Bn, NBITS).astype(jnp.int32)
+    xbar = jnp.einsum("brj,bj->br", chi, xb) & 1
+    bits_a = (
+        hs.unpack_bits_core(rows_a)
+        .reshape(Bn, NBITS, KAPPA)
+        .astype(jnp.int32)
+    )
+    tbar = jnp.einsum("brj,bjc->brc", chi, bits_a) & 1
+    return (
+        hs.pack_bits_core(xbar.astype(jnp.uint8)),
+        hs.pack_bits_core(tbar.astype(jnp.uint8)),
+    )
+
+
+@jax.jit
+def _k_kos_verify(rows_b, delta_bits, U, xbar_p, tbar_p, leaf_p, node_p, prg_p):
+    """Bob's side of the correlation check: χ·Q == t̄ ⊕ x̄ ⊗ Δ per lane
+    (Q rows satisfy q_j = t_j ⊕ x_j·Δ exactly when Alice used one
+    consistent choice vector). → (B,) bool, soundness 2^-κ."""
+    Bn = rows_b.shape[0] // NBITS
+    chi = _chi_bits(U, leaf_p, node_p, prg_p)
+    bits_b = (
+        hs.unpack_bits_core(rows_b)
+        .reshape(Bn, NBITS, KAPPA)
+        .astype(jnp.int32)
+    )
+    qbar = jnp.einsum("brj,bjc->brc", chi, bits_b) & 1
+    xbar = hs.unpack_bits_core(xbar_p).astype(jnp.int32)  # (B, κ)
+    tbar = (
+        hs.unpack_bits_core(tbar_p).astype(jnp.int32)  # (B, κ, κ)
+    )
+    want = tbar ^ (xbar[..., None] * delta_bits.astype(jnp.int32)[None, None, :])
+    return jnp.all(qbar == want, axis=(-2, -1))
+
+
+def _psi_weights(y0, y1, leaf_p, node_p, prg_p) -> jnp.ndarray:
+    """Per-lane Gilboa check weights ψ_i ∈ Z_q, FS-derived from the
+    MASKED payload rows (so they are fixed only after Bob commits to
+    his payloads): leaf digests of (y0_i ‖ y1_i ‖ index) → Merkle root
+    → PRG → mod-q reduction. (M, 32) ×2 → (B, NBITS, n)."""
+    M = y0.shape[0]
+    Bn = M // NBITS
+    P = leaf_p.shape[0]
+    idx_le = hs.le32_bytes(jnp.arange(M, dtype=jnp.uint32))
+    msg = jnp.concatenate(
+        [jnp.broadcast_to(leaf_p, (M, P)), y0, y1, idx_le], axis=-1
+    )
+    leaves = hs.sha256_core(msg, P + 68).reshape(Bn, NBITS, 32)
+    root = _merkle_root(leaves, node_p)  # (B, 32)
+    raw = hs.prg_expand_core(root, prg_p, NBITS, jnp.uint32(0))
+    return _reduce_bytes(raw.reshape(Bn, NBITS, 32))
+
+
+# The EC legs of the Gilboa/consistency checks go through SHARED jit
+# units below (one compiled ladder per primitive, points crossing the
+# boundaries as SecpPointJ pytrees) instead of inlining sp.base_mul /
+# sp.scalar_mul into each check kernel: inlined, the three kernels
+# re-compile the same 256-step scan ladders nine times over (~143 s
+# cold on the 1-core CPU host); shared, each ladder compiles once.
+# All-integer math, so the split is bit-exact — wire bytes and
+# verdicts are unchanged.
+
+
+@jax.jit
+def _k_ec_base_mul(bits):
+    """Shared fixed-base ladder: (B, NBITS) bits → b·G (Jacobian)."""
+    return sp.base_mul(bits)
+
+
+@jax.jit
+def _k_ec_scalar_mul(bits, p):
+    """Shared variable-base ladder: (B, NBITS) bits × point (Jacobian)."""
+    return sp.scalar_mul(bits, p)
+
+
+@jax.jit
+def _k_ec_add_eq(a, b, c):
+    """Shared check tail: a + b == c over Jacobian points → (B,) bool."""
+    return sp.equal(sp.add(a, b), c)
+
+
+@jax.jit
+def _k_ec_encode(p):
+    """Shared SEC1 encode (the one field-inversion ladder)."""
+    return _pt_encode(p)
+
+
+@jax.jit
+def _k_ec_decode(b):
+    """Shared SEC1 decode → (SecpPointJ, ok mask); no ladder."""
+    return _pt_decode(b)
+
+
+@jax.jit
+def _k_gilboa_bob_scalars(y0, y1, z_red, b_scalars, leaf_p, node_p, prg_p):
+    """Scalar half of Bob's opening: ψ-weighted sum D = Σψ_i·z_i mod q
+    plus the b and −Σz exponent bit vectors for the shared ladders."""
+    psi = _psi_weights(y0, y1, leaf_p, node_p, prg_p)
+    ring = sp.scalar_ring()
+    D = _sum_mod_q(ring.mulmod(psi, z_red))
+    return (
+        bn.limbs_to_bytes_le(D, P256, 32),
+        bn.limbs_to_bits(b_scalars, P256, NBITS),
+        bn.limbs_to_bits(_neg_sum_mod_q(z_red), P256, NBITS),
+    )
+
+
+def _k_gilboa_bob(y0, y1, z_red, b_scalars, leaf_p, node_p, prg_p):
+    """Bob's Gilboa/consistency opening for one payload set:
+    D = Σψ_i·z_i mod q plus the curve commitments B = b·G and β·G.
+    → (D LE bytes (B, 32), uncompressed B_pt (B, 65), Beta_pt (B, 65))."""
+    D_bytes, b_bits, nz_bits = _k_gilboa_bob_scalars(
+        y0, y1, z_red, b_scalars, leaf_p, node_p, prg_p
+    )
+    return (
+        D_bytes,
+        _k_ec_encode(_k_ec_base_mul(b_bits)),
+        _k_ec_encode(_k_ec_base_mul(nz_bits)),
+    )
+
+
+@jax.jit
+def _k_gilboa_alice_scalars(y0, y1, msel, x_bits, D_bytes, leaf_p, node_p, prg_p):
+    """Scalar half of Alice's encoding check: the ψ-weighted selected
+    sum A_ψ, the re-reduced D and the masked power sum c_x, each as the
+    exponent bit vectors the shared ladders consume."""
+    psi = _psi_weights(y0, y1, leaf_p, node_p, prg_p)
+    ring = sp.scalar_ring()
+    Bn = msel.shape[0]
+    A_psi = _sum_mod_q(ring.mulmod(psi, _reduce_bytes(msel)))
+    one = jnp.asarray(bn.batch_to_limbs([1], P256))
+    pow2 = jnp.moveaxis(_pow2_ladder(one), 0, 1)  # (1, NBITS, n): 2^i
+    xb = x_bits.reshape(Bn, NBITS)
+    psi_x = jnp.where((xb != 0)[..., None], psi, jnp.zeros_like(psi))
+    c_x = _sum_mod_q(
+        ring.mulmod(psi_x, jnp.broadcast_to(pow2, psi.shape))
+    )
+    D = ring.reduce(bn.bytes_to_limbs_le(D_bytes, P256, 22))
+    return (
+        bn.limbs_to_bits(A_psi, P256, NBITS),
+        bn.limbs_to_bits(D, P256, NBITS),
+        bn.limbs_to_bits(c_x, P256, NBITS),
+    )
+
+
+def _k_gilboa_alice(y0, y1, msel, x_bits, D_bytes, B_comp, leaf_p, node_p, prg_p):
+    """Alice's Gilboa encoding check for one payload set:
+    (Σψ_i·m_sel,i)·G == D·G + (Σ_{x_i=1} ψ_i·2^i)·B — any selected
+    payload inconsistent with the (z, b) encoding Bob opened shifts the
+    left side by a ψ-weighted offset, caught except with probability
+    ~2^-256 over χ-independent ψ. msel is the UNMASKED selection bytes
+    (B·NBITS → (B, NBITS, 32)); a non-decodable B_pt folds into a
+    False verdict. → (B,) bool."""
+    a_bits, d_bits, cx_bits = _k_gilboa_alice_scalars(
+        y0, y1, msel, x_bits, D_bytes, leaf_p, node_p, prg_p
+    )
+    B_pt, okB = _k_ec_decode(B_comp)
+    lhs = _k_ec_base_mul(a_bits)
+    return _k_ec_add_eq(
+        _k_ec_base_mul(d_bits),
+        _k_ec_scalar_mul(cx_bits, B_pt),
+        lhs,
+    ) & okB
+
+
+@jax.jit
+def _k_alpha_bits(alpha):
+    """Limbs → exponent bit vector for the consistency check's α·G."""
+    return bn.limbs_to_bits(alpha, P256, NBITS)
+
+
+def _k_consistency(alpha, x_bits, B_comp, Beta_comp):
+    """MtA output consistency for one payload set: α·G + β·G == a·B —
+    the advertised output shares must land on the checked product.
+    x_bits are Alice's choice bits (= bits of a, LSB-first). → (B,)."""
+    Bn = alpha.shape[0]
+    B_pt, okB = _k_ec_decode(B_comp)
+    beta_pt, okE = _k_ec_decode(Beta_comp)
+    lhs = _k_ec_base_mul(_k_alpha_bits(alpha))
+    rhs = _k_ec_scalar_mul(
+        x_bits.reshape(Bn, NBITS).astype(jnp.int32), B_pt
+    )
+    return _k_ec_add_eq(lhs, beta_pt, rhs) & okB & okE
+
+
+def _tamper_lane_view(field: str, arr: np.ndarray, lane: int) -> np.ndarray:
+    """The slice of a wire tensor one batch lane owns — the corruption
+    surface an active cheater controls for that session."""
+    if field == "U":
+        return arr[:, lane * 32:(lane + 1) * 32]
+    if field in ("kos_xbar", "kos_tbar", "D", "B_pt", "Beta_pt"):
+        return arr[lane]
+    if field in ("y0", "y1"):
+        return arr[lane * NBITS:(lane + 1) * NBITS]
+    raise ValueError(f"unknown tamper field {field!r}")
+
+
+def _apply_tamper(spec: Dict, msg: Dict) -> bool:
+    """Mutate one byte of one lane's slice of ``spec["field"]`` inside a
+    round message (no-op, returning False, when the field is absent —
+    the caller then targets the other round). Writes through a fresh
+    copy so device-backed arrays stay untouched."""
+    field = spec["field"]
+    if field not in msg:
+        return False
+    arr = np.array(msg[field])
+    view = _tamper_lane_view(field, arr, int(spec.get("lane", 0)))
+    idx = np.unravel_index(int(spec.get("byte", 0)) % view.size, view.shape)
+    view[idx] = view[idx] ^ np.uint8(int(spec.get("xor", 1)) or 1)
+    msg[field] = arr
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -474,6 +845,97 @@ class OTMtALeg:
             self._dev_state = st
         return st
 
+    # -- check verdicts / blame / tamper hook --------------------------------
+
+    def _store_verdicts(self, **named: np.ndarray) -> None:
+        """Merge per-check verdict arrays from the last invocation into
+        ``check_verdicts``: {"kos": (B,), "gilboa": (S, B),
+        "consistency": (S, B)} bool. Wire rounds fill the dict from
+        both verifier roles; the in-process paths fill it in one pass."""
+        v = getattr(self, "check_verdicts", None)
+        if v is None:
+            v = {}
+        v.update(named)
+        self.check_verdicts = v
+
+    def check_blame(self) -> Optional[List[Optional[Tuple[str, str]]]]:
+        """Per-lane blame from the last invocation's verdicts: None for
+        a clean lane, else ("alice"|"bob", check name). KOS failure
+        DOMINATES for a lane: a corrupted extension matrix garbles the
+        pads, so the downstream payload checks fail as a side effect of
+        Alice's deviation — attributing them to Bob would misblame.
+        Returns None when checks were off (no verdicts collected)."""
+        v = getattr(self, "check_verdicts", None)
+        if not v:
+            return None
+        kos = v.get("kos")
+        gil = v.get("gilboa")
+        con = v.get("consistency")
+        Bn = next(iter(v.values())).shape[-1]
+        out: List[Optional[Tuple[str, str]]] = []
+        for i in range(Bn):
+            if kos is not None and not kos[i]:
+                out.append(("alice", CHECK_KOS))
+            elif gil is not None and not gil[:, i].all():
+                out.append(("bob", CHECK_GILBOA))
+            elif con is not None and not con[:, i].all():
+                out.append(("bob", CHECK_CONSISTENCY))
+            else:
+                out.append(None)
+        return out
+
+    def set_tamper(self, spec: Optional[Dict]) -> None:
+        """Install a deterministic wire corruption for the NEXT
+        run_multi calls (tests / chaos drills): the leg executes the
+        serial three-round composition and mutates one wire field
+        between rounds — exactly what an active cheater controls.
+        spec keys: field ("U" | "kos_xbar" | "kos_tbar" | "y0" | "y1" |
+        "D" | "B_pt" | "Beta_pt"), lane (batch index), set (payload-set
+        index, payload fields), byte (offset into the lane's slice),
+        xor (mask, default 0x01). None clears."""
+        self._tamper = spec
+
+    def _verify_inprocess(
+        self, tag, rows_a, rows_b, U, r_bits, b_list, z_raw, y0s, y1s,
+        sels, alphas,
+    ):
+        """Full-width check pass for the in-process run paths: the same
+        kernels the wire rounds run, fed the same wire tensors, so the
+        verdicts are bit-identical to the three-round composition
+        (host or device arrays accepted — jnp.asarray is a no-op on
+        device residents)."""
+        r_bits_d = jnp.asarray(r_bits)
+        U_d = jnp.asarray(U)
+        kos_pref = _fs_prefixes(tag, b"kos")
+        xbar, tbar = _k_kos_tags(
+            jnp.asarray(rows_a), r_bits_d, U_d, *kos_pref
+        )
+        kos_ok = _k_kos_verify(
+            jnp.asarray(rows_b), jnp.asarray(self.delta), U_d,
+            xbar, tbar, *kos_pref,
+        )
+        g_oks, c_oks = [], []
+        for s, b_s in enumerate(b_list):
+            pref = _fs_prefixes(tag, b"gilboa", s)
+            y0_d, y1_d = jnp.asarray(y0s[s]), jnp.asarray(y1s[s])
+            z_red = _reduce_bytes(jnp.asarray(z_raw[s]))
+            D_b, B_comp, Beta_comp = _k_gilboa_bob(
+                y0_d, y1_d, z_red, b_s, *pref
+            )
+            Bn = b_s.shape[0]
+            msel = jnp.asarray(sels[s]).reshape(Bn, NBITS, 32)
+            g_oks.append(_k_gilboa_alice(
+                y0_d, y1_d, msel, r_bits_d, D_b, B_comp, *pref
+            ))
+            c_oks.append(_k_consistency(
+                alphas[s], r_bits_d, B_comp, Beta_comp
+            ))
+        self.check_verdicts = {
+            "kos": np.asarray(kos_ok),  # mpcflow: host-ok — check verdicts are the abort decision (B bools per extension)
+            "gilboa": np.stack([np.asarray(g) for g in g_oks]),  # mpcflow: host-ok — check verdicts are the abort decision (S·B bools per extension)
+            "consistency": np.stack([np.asarray(c) for c in c_oks]),  # mpcflow: host-ok — check verdicts are the abort decision (S·B bools per extension)
+        }
+
     # -- chunk-granular extension stages (host side) -------------------------
     #
     # Each stage covers lanes [blk_off, blk_off + Bc) of the batch — a
@@ -518,7 +980,9 @@ class OTMtALeg:
     # -- Alice ---------------------------------------------------------------
 
     def alice_round1(self, a: jnp.ndarray, ctr: int) -> Dict:
-        """``a``: (B, n) scalars mod q. → {"U": (κ, M/8), "v"} to Bob;
+        """``a``: (B, n) scalars mod q. → {"U": (κ, M/8), "v"} to Bob —
+        plus the KOS correlation tags {"kos_xbar", "kos_tbar"} when
+        checks are on (χ is FS-derived from U, so no extra round);
         local state kept for round 3."""
         B = a.shape[0]
         M = B * NBITS
@@ -526,7 +990,17 @@ class OTMtALeg:
         tag = self._ext_tag(ctr)
         t0, U = self._ext_alice_chunk(tag, _pack(r_bits), 0, B)
         self._alice_state = (t0, r_bits, B, tag)
-        return {"U": U, "v": OT_WIRE_VERSION}
+        self.check_verdicts = None
+        msg = {"U": U, "v": OT_WIRE_VERSION}
+        if ot_checks_enabled():
+            xbar, tbar = _k_kos_tags(
+                hs.ot_transpose_device(jnp.asarray(t0)),
+                jnp.asarray(r_bits), jnp.asarray(U),
+                *_fs_prefixes(tag, b"kos"),
+            )
+            msg["kos_xbar"] = np.asarray(xbar)  # mpcflow: host-ok — KOS tags are wire bytes (B·(κ/8+κ²/8) per extension)
+            msg["kos_tbar"] = np.asarray(tbar)  # mpcflow: host-ok — KOS tags are wire bytes (B·(κ/8+κ²/8) per extension)
+        return msg
 
     def alice_round3(self, bob_msg: Dict) -> jnp.ndarray:
         """Recover the selected payloads → Alice's additive share
@@ -537,14 +1011,23 @@ class OTMtALeg:
         """One extension, several payload sets (see bob_round2_multi):
         per-set pads come from the SAME transposed rows under
         set-separated hash domains, so each set's pads are independent
-        random-oracle outputs."""
+        random-oracle outputs. With checks on, verifies each set's
+        Gilboa ψ-encoding and output-consistency openings against the
+        RECEIVED payload bytes (verdicts → ``check_verdicts``; Alice is
+        the verifier, failures blame Bob)."""
         from ... import native
 
+        checks = ot_checks_enabled()
         for i, m in enumerate(bob_msgs):
             if m.get("v") != OT_WIRE_VERSION:
                 raise ValueError(
                     f"OT-MtA wire version mismatch in bob msg {i}: got "
                     f"{m.get('v')!r}, this party speaks v{OT_WIRE_VERSION}"
+                )
+            if checks and "D" not in m:
+                raise ValueError(
+                    f"OT-MtA checks enabled but bob msg {i} carries no "
+                    "Gilboa opening (peer running MPCIUM_OT_CHECKS=0?)"
                 )
         t0, r_bits, B, tag = self._alice_state
         M = B * NBITS
@@ -552,14 +1035,32 @@ class OTMtALeg:
             self._pad_prefixes(tag, len(bob_msgs)), t0, M
         )
         alphas = []
+        g_oks, c_oks = [], []
         sel_bits = r_bits[:, None].astype(bool)
-        for bob_msg, pads in zip(bob_msgs, pad_sets):
+        for s, (bob_msg, pads) in enumerate(zip(bob_msgs, pad_sets)):
             sel = np.where(sel_bits, bob_msg["y1"], bob_msg["y0"])
             native.xor_rows(sel, pads)  # m_sel, in place
-            alphas.append(
-                _sum_mod_q(
-                    _reduce_bytes(jnp.asarray(sel.reshape(B, NBITS, 32)))
-                )
+            alpha = _sum_mod_q(
+                _reduce_bytes(jnp.asarray(sel.reshape(B, NBITS, 32)))
+            )
+            alphas.append(alpha)
+            if checks:
+                pref = _fs_prefixes(tag, b"gilboa", s)
+                g_oks.append(_k_gilboa_alice(
+                    jnp.asarray(bob_msg["y0"]), jnp.asarray(bob_msg["y1"]),
+                    jnp.asarray(sel.reshape(B, NBITS, 32)),
+                    jnp.asarray(r_bits), jnp.asarray(bob_msg["D"]),
+                    jnp.asarray(bob_msg["B_pt"]), *pref,
+                ))
+                c_oks.append(_k_consistency(
+                    alpha, jnp.asarray(r_bits),
+                    jnp.asarray(bob_msg["B_pt"]),
+                    jnp.asarray(bob_msg["Beta_pt"]),
+                ))
+        if checks:
+            self._store_verdicts(
+                gilboa=np.stack([np.asarray(g) for g in g_oks]),  # mpcflow: host-ok — check verdicts are the abort decision (S·B bools per extension)
+                consistency=np.stack([np.asarray(c) for c in c_oks]),  # mpcflow: host-ok — check verdicts are the abort decision (S·B bools per extension)
             )
         return alphas
 
@@ -582,9 +1083,14 @@ class OTMtALeg:
         expensive extension half (t/U PRG expansion, the Q matrix) runs
         once and only the per-set payload masking repeats, under
         set-separated pad domains (`…|s0`, `…|s1`: independent RO
-        outputs from the same rows)."""
+        outputs from the same rows). With checks on, verifies Alice's
+        KOS correlation tags against the received U (verdict →
+        ``check_verdicts``; Bob is the verifier, failure blames Alice)
+        and attaches each set's Gilboa opening {"D", "B_pt",
+        "Beta_pt"}."""
         from ... import native
 
+        checks = ot_checks_enabled()
         b_list = tuple(b_list)
         if any(b.shape != b_list[0].shape for b in b_list):
             raise ValueError(
@@ -592,21 +1098,38 @@ class OTMtALeg:
                 f"{[tuple(b.shape) for b in b_list]}"
             )
         if alice_msg.get("v") != OT_WIRE_VERSION:
+            # mpclint: disable=MPF702 — the formatted value is the public wire-version field (a small int every peer sees), not the PRG-derived tensors that taint the message dict
             raise ValueError(
                 f"OT-MtA wire version mismatch: alice msg carries "
                 f"{alice_msg.get('v')!r}, this party speaks "
                 f"v{OT_WIRE_VERSION} (mixed-version quorum?)"
             )
+        if checks and "kos_xbar" not in alice_msg:
+            raise ValueError(
+                "OT-MtA checks enabled but alice msg carries no KOS "
+                "tags (peer running MPCIUM_OT_CHECKS=0?)"
+            )
         B = b_list[0].shape[0]
         M = B * NBITS
         tag = self._ext_tag(ctr)
         Qm = self._ext_bob_chunk(tag, alice_msg["U"], 0, B)
+        if checks:
+            kos_ok = _k_kos_verify(
+                hs.ot_transpose_device(jnp.asarray(Qm)),
+                jnp.asarray(self.delta), jnp.asarray(alice_msg["U"]),
+                jnp.asarray(alice_msg["kos_xbar"]),
+                jnp.asarray(alice_msg["kos_tbar"]),
+                *_fs_prefixes(tag, b"kos"),
+            )
+            self._store_verdicts(kos=np.asarray(kos_ok))  # mpcflow: host-ok — check verdicts are the abort decision (B bools per extension)
         pad_sets = _derive_pads_multi(
             self._pad_prefixes(tag, len(b_list)), Qm, M,
             delta=self.delta_packed,
         )
         msgs, betas = [], []
-        for (b_scalars, (pad0, pad1)) in zip(b_list, pad_sets):
+        for s, (b_scalars, (pad0, pad1)) in enumerate(
+            zip(b_list, pad_sets)
+        ):
             # payloads: z and z + 2^i·b (mod q), z freshly random per OT
             z_raw = np.frombuffer(
                 self.rng.token_bytes(M * 32), np.uint8
@@ -617,7 +1140,16 @@ class OTMtALeg:
             # mask INTO the pad buffers (ours, writable, dead after)
             y0 = native.xor_rows(pad0, m0.reshape(M, 32))
             y1 = native.xor_rows(pad1, m1.reshape(M, 32))
-            msgs.append({"y0": y0, "y1": y1, "v": OT_WIRE_VERSION})
+            msg = {"y0": y0, "y1": y1, "v": OT_WIRE_VERSION}
+            if checks:
+                D_b, B_comp, Beta_comp = _k_gilboa_bob(
+                    jnp.asarray(y0), jnp.asarray(y1), z_red, b_scalars,
+                    *_fs_prefixes(tag, b"gilboa", s),
+                )
+                msg["D"] = np.asarray(D_b)  # mpcflow: host-ok — Gilboa openings are wire bytes (B·98 per set)
+                msg["B_pt"] = np.asarray(B_comp)  # mpcflow: host-ok — Gilboa openings are wire bytes (B·98 per set)
+                msg["Beta_pt"] = np.asarray(Beta_comp)  # mpcflow: host-ok — Gilboa openings are wire bytes (B·98 per set)
+            msgs.append(msg)
             betas.append(_neg_sum_mod_q(z_red))
         return msgs, betas
 
@@ -678,6 +1210,9 @@ class OTMtALeg:
                 "run_multi: payload sets disagree on batch shape: "
                 f"{[tuple(b.shape) for b in b_list]}"
             )
+        self.check_verdicts = None  # per-invocation; the check pass refills
+        if getattr(self, "_tamper", None) is not None:
+            return self._run_multi_tampered(a, b_list)
         K = resolve_chunks(B, chunks)
         ctr = self.ctr
         self.ctr += 1
@@ -724,6 +1259,8 @@ class OTMtALeg:
                 per_set.append((m0, m1, _neg_sum_mod_q(z_red)))
             dev.append(per_set)
 
+        checks = ot_checks_enabled()
+
         def host_stage(c: int):
             t0_ = time.perf_counter()
             blk_off = c * Bc
@@ -738,7 +1275,7 @@ class OTMtALeg:
                     timings.get("host_s", 0.0)
                     + time.perf_counter() - t0_
                 )
-            return pads
+            return pads, t0_c, U_c, Qm_c
 
         # the double-buffer: EVERY chunk's host work is enqueued before
         # the first device array is blocked on
@@ -748,10 +1285,18 @@ class OTMtALeg:
         device_wait = 0.0
         alpha_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
         beta_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
+        # per-chunk wire tensors, kept only for the check pass
+        t0_cs, Qm_cs = [], []
+        U_cs = []
+        y_cs = [([], [], []) for _ in b_list]  # (y0, y1, sel) per set
         for c in range(K):
             t_w = time.perf_counter()
-            padsA, padsB = futs[c].result()
+            (padsA, padsB), t0_c, U_c, Qm_c = futs[c].result()
             host_wait += time.perf_counter() - t_w
+            if checks:
+                t0_cs.append(t0_c)
+                U_cs.append(U_c)
+                Qm_cs.append(Qm_c)
             sel_bits = r_bits[c * Mc:(c + 1) * Mc, None].astype(bool)
             for s in range(len(b_list)):
                 m0_d, m1_d, beta_d = dev[c][s]
@@ -772,6 +1317,10 @@ class OTMtALeg:
                     )
                 )
                 beta_pieces[s].append(beta_d)
+                if checks:
+                    y_cs[s][0].append(y0)
+                    y_cs[s][1].append(y1)
+                    y_cs[s][2].append(sel)
 
         alphas = [
             p[0] if K == 1 else jnp.concatenate(p, axis=0)
@@ -781,6 +1330,26 @@ class OTMtALeg:
             p[0] if K == 1 else jnp.concatenate(p, axis=0)
             for p in beta_pieces
         ]
+        checks_s = 0.0
+        if checks:
+            t_chk = time.perf_counter()
+            t0_full = np.concatenate(t0_cs, axis=1)
+            Qm_full = np.concatenate(Qm_cs, axis=1)
+            self._verify_inprocess(
+                tag,
+                hs.ot_transpose_device(jnp.asarray(t0_full)),
+                hs.ot_transpose_device(jnp.asarray(Qm_full)),
+                np.concatenate(U_cs, axis=1), r_bits, b_list, z_raw,
+                [np.concatenate(ys[0], axis=0) for ys in y_cs],
+                [np.concatenate(ys[1], axis=0) for ys in y_cs],
+                [np.concatenate(ys[2], axis=0) for ys in y_cs],
+                alphas,
+            )
+            checks_s = time.perf_counter() - t_chk
+        if timings is not None:
+            timings["checks_s"] = (
+                timings.get("checks_s", 0.0) + checks_s
+            )
         if timings is not None:
             timings["host_wait_s"] = (
                 timings.get("host_wait_s", 0.0) + host_wait
@@ -799,8 +1368,31 @@ class OTMtALeg:
             node="engine", tid=f"ot:B{B}",
             host_wait_s=round(host_wait, 6),
             device_wait_s=round(device_wait, 6),
-            chunks=K, sets=len(b_list),
+            chunks=K, sets=len(b_list), checks=checks,
         )
+        return list(zip(alphas, betas))
+
+    def _run_multi_tampered(self, a, b_list):
+        """Chaos/test path (``set_tamper``): the serial three-round wire
+        composition with one deterministic corruption applied to the
+        cheating party's outbound message — alice fields (U, KOS tags)
+        before Bob's round 2, bob fields (payloads, openings) before
+        Alice's round 3 — so the verdicts exercised are exactly the
+        receiving verifier's, on real wire bytes."""
+        spec = self._tamper
+        ctr = self.ctr
+        self.ctr += 1
+        msg_a = self.alice_round1(a, ctr)
+        applied = _apply_tamper(spec, msg_a)
+        msgs_b, betas = self.bob_round2_multi(b_list, msg_a, ctr)
+        if not applied:
+            target = msgs_b[int(spec.get("set", 0))]
+            if not _apply_tamper(spec, target):
+                raise ValueError(
+                    f"tamper field {spec['field']!r} absent from both "
+                    "rounds (checks disabled?)"
+                )
+        alphas = self.alice_round3_multi(msgs_b)
         return list(zip(alphas, betas))
 
     def _run_multi_device(
@@ -831,8 +1423,12 @@ class OTMtALeg:
         r_bits_d = _bits_256(a).astype(jnp.uint8).reshape(M)
         r_packed_d = hs.pack_bits_core(r_bits_d)
 
+        checks = ot_checks_enabled()
         alpha_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
         beta_pieces: List[List[jnp.ndarray]] = [[] for _ in b_list]
+        rows_a_cs, rows_b_cs, U_cs = [], [], []
+        sel_cs: List[List[jnp.ndarray]] = [[] for _ in b_list]
+        y_cs: List[Tuple[List, List]] = [([], []) for _ in b_list]
         for c in range(K):
             sl = slice(c * Bc, (c + 1) * Bc)
             m0s, m1s = [], []
@@ -841,16 +1437,26 @@ class OTMtALeg:
                 m1s.append(_m1_payloads(z_red, _pow2_ladder(b_s[sl])))
                 m0s.append(bn.limbs_to_bytes_le(z_red, P256, 32))
                 beta_pieces[s].append(_neg_sum_mod_q(z_red))
-            alphas_c, U_c, y0s_c, y1s_c = _ot_chunk_device(
-                dev["k0"], dev["k1"], dev["kD"], dev["delta_mask"],
-                dev["delta_packed"], prg_prefix, pad_prefixes,
-                r_bits_d[c * Mc:(c + 1) * Mc],
-                r_packed_d[c * Bc * 32:(c + 1) * Bc * 32],
-                jnp.stack(m0s), jnp.stack(m1s),
-                jnp.uint32(c * Bc), jnp.uint32(c * Mc),
+            alphas_c, U_c, y0s_c, y1s_c, rows_a_c, rows_b_c, sels_c = (
+                _ot_chunk_device(
+                    dev["k0"], dev["k1"], dev["kD"], dev["delta_mask"],
+                    dev["delta_packed"], prg_prefix, pad_prefixes,
+                    r_bits_d[c * Mc:(c + 1) * Mc],
+                    r_packed_d[c * Bc * 32:(c + 1) * Bc * 32],
+                    jnp.stack(m0s), jnp.stack(m1s),
+                    jnp.uint32(c * Bc), jnp.uint32(c * Mc),
+                )
             )
             for s in range(n_sets):
                 alpha_pieces[s].append(alphas_c[s])
+            if checks:
+                rows_a_cs.append(rows_a_c)
+                rows_b_cs.append(rows_b_c)
+                U_cs.append(U_c)
+                for s in range(n_sets):
+                    sel_cs[s].append(sels_c[s])
+                    y_cs[s][0].append(y0s_c[s])
+                    y_cs[s][1].append(y1s_c[s])
             if transcript is not None:
                 transcript.append({
                     "U": np.asarray(U_c),  # mpcflow: host-ok — transcript-oracle capture (tests only; None in production)
@@ -866,6 +1472,24 @@ class OTMtALeg:
             p[0] if K == 1 else jnp.concatenate(p, axis=0)
             for p in beta_pieces
         ]
+        if checks:
+            t_chk = time.perf_counter()
+            self._verify_inprocess(
+                tag,
+                jnp.concatenate(rows_a_cs, axis=0),
+                jnp.concatenate(rows_b_cs, axis=0),
+                jnp.concatenate(U_cs, axis=1),
+                r_bits_d, b_list, z_raw,
+                [jnp.concatenate(ys[0], axis=0) for ys in y_cs],
+                [jnp.concatenate(ys[1], axis=0) for ys in y_cs],
+                [jnp.concatenate(p, axis=0) for p in sel_cs],
+                alphas,
+            )
+            if timings is not None:
+                timings["checks_s"] = (
+                    timings.get("checks_s", 0.0)
+                    + time.perf_counter() - t_chk
+                )
         if timings is not None:
             timings["total_s"] = (
                 timings.get("total_s", 0.0)
@@ -875,6 +1499,6 @@ class OTMtALeg:
             "phase:ot_extension", t_span0, tracing.now_ns(),
             node="engine", tid=f"ot:B{B}",
             host_wait_s=0.0, device_wait_s=0.0,
-            chunks=K, sets=n_sets, device=True,
+            chunks=K, sets=n_sets, device=True, checks=checks,
         )
         return list(zip(alphas, betas))

@@ -73,7 +73,7 @@ class WarmKnobs:
 
     q: Tuple[int, ...] = (2,)
     key_type: Tuple[str, ...] = ("ed25519", "secp256k1")
-    mta_impl: Tuple[str, ...] = ("paillier",)
+    mta_impl: Tuple[str, ...] = ("paillier", "ot")
     t_new: Tuple[int, ...] = (1,)
 
     def values_for(self, name: str) -> Tuple[str, ...]:
@@ -91,14 +91,18 @@ class WarmKnobs:
 
 def default_knobs(threshold: Optional[int] = None) -> WarmKnobs:
     """Knob values for a t-of-n deployment: the serving quorum is t+1
-    and reshares rotate to the same threshold. The MtA backend is
-    whatever this process would actually serve (``MPCIUM_MTA``)."""
+    and reshares rotate to the same threshold. The MtA backend axis is
+    whatever this process would actually serve (``MPCIUM_MTA``) plus
+    ``ot`` — the OT backend's active-security check kernels (ISSUE 16)
+    ride the gg18.sign signature, and a node must be able to flip to
+    the checked backend without hitting a cold compile."""
     t = 1 if threshold is None else int(threshold)
     if t < 1:
         raise ValueError(f"need threshold >= 1, got {t}")
+    mta = os.environ.get("MPCIUM_MTA", "paillier")
     return WarmKnobs(
         q=(t + 1,),
-        mta_impl=(os.environ.get("MPCIUM_MTA", "paillier"),),
+        mta_impl=(mta,) if mta == "ot" else (mta, "ot"),
         t_new=(t,),
     )
 

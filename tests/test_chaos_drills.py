@@ -71,6 +71,36 @@ def test_drill_kill_resume():
     assert report.to_json()["warm"] == report.warm
 
 
+@pytest.mark.slow
+def test_drill_cheater_caught_and_quarantined():
+    """An active cheater corrupts one PRF-chosen OT-MtA wire field in
+    one batch lane: the checks catch it and blame exactly the cheating
+    party, the scheduler quarantines that session behind one retryable
+    culprit-named ABORT event, the survivors re-pack onto pow-2
+    sub-batches and complete — under live EdDSA traffic (ISSUE 16).
+
+    Slow-marked: a full GG18+OT signing round with checks on costs
+    ~70 s of EC-ladder execution on the 1-core CPU host; the per-check
+    adversarial coverage stays tier-1 in test_tamper_checks.py and the
+    quarantine semantics in test_cohort_quarantine.py."""
+    report = run_drill("cheater", seed=7)
+    _assert_ok(report)
+    # the report names the culprit: session, lane, party, check
+    assert set(report.culprit) == {
+        "session", "lane", "party", "check", "field",
+    }
+    assert report.culprit["party"] in ("alice", "bob")
+    # and carries the survivors' completion stats with a closed invariant
+    s = report.survivors
+    assert s["submitted"] == s["completed"] + s["quarantined"]
+    assert s["pending"] == 0 and s["quarantined"] == 1
+    assert all(isinstance(n, int) for n in s["chunks"])  # pow-2 snapped
+    assert report.to_json()["culprit"] == report.culprit
+    # reproducibility: the deviation is PRF-derived from (seed, plan)
+    assert report.plan["seed"] == 7
+    assert report.plan["rules"][0]["kind"] == "tamper"
+
+
 def test_drill_report_reproducible_from_seed():
     """Same (drill, seed) ⇒ same outcome and the identical serialized
     plan — the reproduction contract scripts/chaos_drill.py documents."""

@@ -1,0 +1,174 @@
+"""Scheduler-side identifiable abort (ISSUE 16): when a batch dies with
+engine.abort.CohortAbort, the scheduler quarantines EXACTLY the blamed
+sessions — one retryable ABORT event each, naming the culprit party and
+check, under a distinct idempotency key — and re-packs the survivors
+onto bucket-snapped pow-2 sub-batches that run to completion. No
+cluster, no engine: the same bare-scheduler harness as
+test_batch_claims.py, with the batch runner recorded."""
+import json
+import threading
+import types
+
+from mpcium_tpu import wire
+from mpcium_tpu.consumers.batch_scheduler import BatchSigningScheduler
+from mpcium_tpu.engine.abort import CohortAbort
+from mpcium_tpu.transport.loopback import LoopbackFabric
+
+
+def _msg(i):
+    return wire.SignTxMessage(
+        key_type="ecdsa", wallet_id=f"qw{i}", network_internal_code="eth",
+        tx_id=f"qtx{i}", tx=b"tx-%d" % i,
+    )
+
+
+class _Harness:
+    """A scheduler whose engine dispatch records instead of signing."""
+
+    def __init__(self, survivors_expected):
+        self.completed = []
+        self.done = threading.Event()
+        self.events = []
+        self._ev_lock = threading.Lock()
+        harness = self
+
+        class _Recording(BatchSigningScheduler):
+            def _run_batch(self, batch_id, reqs, *mid, **kw):
+                harness.completed.append(
+                    (batch_id, [m.tx_id for m, _r in reqs])
+                )
+                if harness.count() >= survivors_expected:
+                    harness.done.set()
+
+        self.fabric = LoopbackFabric()
+        t = self.fabric.transport()
+        self.sub = t.queues.dequeue(
+            f"{wire.TOPIC_SIGNING_RESULT}.*", self._on_result
+        )
+        self.sched = _Recording(
+            types.SimpleNamespace(node_id="n0", peer_ids=["n0"]),
+            transport=t,
+        )
+
+    def _on_result(self, data):
+        with self._ev_lock:
+            self.events.append(
+                wire.SigningResultEvent.from_json(json.loads(data))
+            )
+
+    def count(self):
+        return sum(len(t) for _b, t in self.completed)
+
+    def close(self):
+        self.sched.close()
+        self.sub.unsubscribe()
+        self.fabric.close()
+
+
+def test_quarantine_names_culprit_and_repacks_survivors():
+    h = _Harness(survivors_expected=3)
+    try:
+        reqs = [(_msg(i), "") for i in range(4)]
+        abort = CohortAbort([(1, "node-b", "gilboa")], engine="gg18.sign")
+        h.sched._absorb_cohort_abort("b0", reqs, frozenset(),
+                                     abort.culprits)
+        assert h.done.wait(10), f"survivors never ran: {h.completed}"
+        h.fabric.drain(timeout_s=10)
+
+        # exactly one ABORT event, for the blamed session only —
+        # retryable, culprit-named, distinct idempotency key family
+        (ev,) = h.events
+        assert ev.tx_id == "qtx1" and ev.result_type == wire.RESULT_ERROR
+        assert ev.retryable
+        assert "node-b" in ev.error_reason and "gilboa" in ev.error_reason
+        assert "identifiable abort" in ev.error_reason
+
+        # survivors: every non-blamed tx exactly once, in pow-2 chunks
+        survivor_txs = sorted(t for _b, ts in h.completed for t in ts)
+        assert survivor_txs == ["qtx0", "qtx2", "qtx3"]
+        chunks = [len(ts) for _b, ts in h.completed]
+        assert all(n & (n - 1) == 0 for n in chunks), chunks
+        assert sorted(b for b, _t in h.completed) == ["b0r0", "b0r1"]
+
+        # soak invariant closes: submitted == completed + quarantined
+        assert 4 == h.count() + len(h.events)
+        # and no claim leaks once the children exit
+        assert h.sched._batch_claims == {}
+    finally:
+        h.close()
+
+
+def test_multiple_culprits_one_event_each():
+    h = _Harness(survivors_expected=2)
+    try:
+        reqs = [(_msg(i), "") for i in range(4)]
+        abort = CohortAbort(
+            [(0, "node-a", "kos"), (3, "node-b", "consistency")],
+            engine="gg18.sign",
+        )
+        h.sched._absorb_cohort_abort("b1", reqs, frozenset(),
+                                     abort.culprits)
+        assert h.done.wait(10)
+        h.fabric.drain(timeout_s=10)
+        by_tx = {e.tx_id: e for e in h.events}
+        assert set(by_tx) == {"qtx0", "qtx3"}
+        assert "kos" in by_tx["qtx0"].error_reason
+        assert "node-a" in by_tx["qtx0"].error_reason
+        assert "consistency" in by_tx["qtx3"].error_reason
+        assert all(e.retryable for e in by_tx.values())
+        assert sorted(t for _b, ts in h.completed for t in ts) == \
+            ["qtx1", "qtx2"]
+    finally:
+        h.close()
+
+
+def test_all_lanes_blamed_no_survivor_batch():
+    h = _Harness(survivors_expected=1)  # never reached
+    try:
+        reqs = [(_msg(i), "") for i in range(2)]
+        abort = CohortAbort(
+            [(0, "p0", "kos"), (1, "p1", "gilboa")], engine="gg18.sign"
+        )
+        h.sched._absorb_cohort_abort("b2", reqs, frozenset(),
+                                     abort.culprits)
+        h.fabric.drain(timeout_s=10)
+        assert len(h.events) == 2 and h.completed == []
+    finally:
+        h.close()
+
+
+def test_cohort_abort_duck_typing_contract():
+    """The on_error seam in _run_batch routes on ``getattr(e,
+    "culprits", None)`` — duck-typed so a distributed party can forward
+    a peer's verdicts without importing the engine. Pin both sides of
+    the contract: CohortAbort coerces and exposes culprits, a plain
+    failure exposes none, and the exception text names every blame."""
+    abort = CohortAbort(
+        [("2", "node-x", "kos"), (0, 7, "gilboa")], engine="gg18.sign"
+    )
+    assert getattr(abort, "culprits", None) == [
+        (2, "node-x", "kos"), (0, "7", "gilboa"),
+    ]
+    assert abort.lanes() == [0, 2]
+    assert "party node-x failed check 'kos'" in str(abort)
+    assert "gg18.sign" in str(abort)
+    assert getattr(RuntimeError("engine died"), "culprits", None) is None
+
+
+def test_quarantine_on_closed_scheduler_releases_not_spawns():
+    """A cohort abort racing shutdown must not spawn survivor threads
+    on a closed scheduler; the quarantine events still go out."""
+    h = _Harness(survivors_expected=1)
+    try:
+        reqs = [(_msg(i), "") for i in range(4)]
+        with h.sched._lock:
+            h.sched._closed = True
+        h.sched._absorb_cohort_abort(
+            "b3", reqs, frozenset(),
+            CohortAbort([(0, "p", "kos")]).culprits,
+        )
+        h.fabric.drain(timeout_s=10)
+        assert [e.tx_id for e in h.events] == ["qtx0"]
+        assert h.completed == []  # no survivor re-pack after close
+    finally:
+        h.close()

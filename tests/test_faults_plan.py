@@ -4,6 +4,7 @@ diverge, retransmissions re-roll, and plans survive JSON round-trips."""
 import pytest
 
 from mpcium_tpu.faults.plan import (
+    TAMPER_MODES,
     FaultPlan,
     MsgEvent,
     Rule,
@@ -15,6 +16,7 @@ from mpcium_tpu.faults.plan import (
     named_plan,
     partition,
     reorder,
+    tamper,
 )
 
 
@@ -127,11 +129,96 @@ def test_crash_rule_is_one_shot():
 
 def test_named_plans_cover_the_catalog():
     for name in ("drop-jitter", "node-crash", "broker-failover",
-                 "partition", "duplicate-reorder"):
+                 "partition", "duplicate-reorder", "cheater"):
         p = named_plan(name, seed=3)
         assert isinstance(p, FaultPlan) and p.seed == 3
     with pytest.raises(KeyError):
         named_plan("nope", seed=3)
+
+
+def test_tamper_flip_is_deterministic_single_byte():
+    """flip: same (seed, rule, key, occ, data) ⇒ the identical
+    corrupted payload — one byte XORed with a nonzero mask, same
+    length, never a no-op."""
+    data = bytes(range(64)) * 4
+    corrupt = []
+    for _ in range(2):
+        plan = FaultPlan(17, [tamper(mode="flip", topic="t:*")])
+        corrupt.append(plan.tamper_bytes(plan.rules[0], b"k", 0, data))
+    assert corrupt[0] == corrupt[1]
+    assert corrupt[0] != data and len(corrupt[0]) == len(data)
+    diffs = [i for i, (x, y) in enumerate(zip(data, corrupt[0])) if x != y]
+    assert len(diffs) == 1
+    # different occurrences / keys pick independent positions+masks
+    plan = FaultPlan(17, [tamper(mode="flip")])
+    outs = {
+        plan.tamper_bytes(plan.rules[0], b"k%d" % i, i, data)
+        for i in range(16)
+    }
+    assert len(outs) > 8
+
+
+def test_tamper_truncate_shortens_to_proper_prefix():
+    plan = FaultPlan(23, [tamper(mode="truncate")])
+    rule = plan.rules[0]
+    data = bytes(range(200))
+    out = plan.tamper_bytes(rule, b"k", 0, data)
+    assert out == plan.tamper_bytes(rule, b"k", 0, data)  # deterministic
+    assert len(out) < len(data) and data.startswith(out)
+    # even a maximal draw keeps at least one byte off the wire
+    for i in range(64):
+        o = plan.tamper_bytes(rule, b"k%d" % i, i, data)
+        assert len(o) <= len(data) - 1
+
+
+def test_tamper_replay_substitutes_previous_matching_payload():
+    """replay: every match captures; a triggered match ships the
+    PREVIOUSLY captured payload instead of the current one (stale
+    retransmission), so the first match always passes through."""
+    plan = FaultPlan(5, [tamper(mode="replay")])
+    rule = plan.rules[0]
+    assert plan.tamper_bytes(rule, b"k", 0, b"first") == b"first"
+    assert plan.tamper_bytes(rule, b"k", 1, b"second") == b"first"
+    assert plan.tamper_bytes(rule, b"k", 2, b"third") == b"second"
+    # untriggered matches still refresh the capture cell
+    assert plan.tamper_bytes(rule, b"k", 3, b"fourth",
+                             triggered=False) == b"fourth"
+    assert plan.tamper_bytes(rule, b"k", 4, b"fifth") == b"fourth"
+
+
+def test_tamper_mode_validated_and_serialized():
+    with pytest.raises(ValueError, match="tamper mode"):
+        tamper(mode="scribble")
+    for mode in TAMPER_MODES:
+        r = tamper(mode=mode, topic="bsign:*", p=0.25)
+        clone = Rule.from_json(r.to_json())
+        assert clone == r and clone.mode == mode
+    # pre-tamper plans (no "mode" key at all) still deserialize
+    d = drop(p=0.5, topic="x").to_json()
+    del d["mode"]
+    assert Rule.from_json(d).mode == ""
+
+
+def test_tamper_schedule_roundtrips_and_is_seed_deterministic():
+    plan = named_plan("cheater", seed=31)
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.to_json() == plan.to_json()
+    data = b"payload-bytes" * 9
+    traffic = [
+        MsgEvent("out", "pubsub", f"bsign:{i % 3}", b"m-%d" % (i % 5), "n")
+        for i in range(30)
+    ]
+    matched = 0
+    for ev in traffic:
+        for r in plan.matching(ev, ("tamper",)):
+            matched += 1
+            u, key, occ = plan.roll(r, ev)
+            (rc,) = clone.matching(ev, ("tamper",))
+            uc, keyc, occc = clone.roll(rc, ev)
+            assert (u, key, occ) == (uc, keyc, occc)
+            assert plan.tamper_bytes(r, key, occ, data) == \
+                clone.tamper_bytes(rc, keyc, occc, data)
+    assert matched == 30  # the cheater rule matches its bsign traffic
 
 
 def test_scale_changes_times_not_structure():

@@ -10,6 +10,7 @@ import pytest
 
 from mpcium_tpu.faults.plan import (
     FaultPlan, crash_node, delay, drop, duplicate, partition, reorder,
+    tamper,
 )
 from mpcium_tpu.faults.transport import CrashSwitch, FaultStats, FaultyTransport
 from mpcium_tpu.transport.api import Permanent, TransportError
@@ -223,6 +224,95 @@ def test_partition_isolates_listed_nodes(fabric):
     ft1.pubsub.publish("t:1", b"healed")
     _drain(fabric)
     assert got == [b"from-connected", b"healed"]
+
+
+def test_tamper_flip_corrupts_pubsub_payload(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [tamper(p=1.0, topic="p:*", channel="pubsub",
+                             mode="flip")]),
+    )
+    got = []
+    fabric.transport().pubsub.subscribe("p:*", lambda d: got.append(d))
+    sent = b"honest-wire-bytes" * 4
+    ft.pubsub.publish("p:1", sent)
+    _drain(fabric)
+    (delivered,) = got
+    assert delivered != sent and len(delivered) == len(sent)
+    assert sum(x != y for x, y in zip(sent, delivered)) == 1
+    (entry,) = ft.stats.schedule
+    assert entry["action"] == "tamper" and entry["mode"] == "flip"
+    assert ft.stats.counters["tamper#0"]["tamper"] == 1
+
+
+def test_tamper_truncate_on_queue_ships_proper_prefix(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(9, [tamper(p=1.0, topic="q:*", channel="queue",
+                             mode="truncate")]),
+    )
+    got = []
+    fabric.transport().queues.dequeue("q:*", lambda d: got.append(d))
+    sent = bytes(range(120))
+    ft.queues.enqueue("q:1", sent, idempotency_key="t1")
+    _drain(fabric)
+    (delivered,) = got
+    assert len(delivered) < len(sent) and sent.startswith(delivered)
+
+
+def test_tamper_replay_on_direct_substitutes_stale_payload(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(5, [tamper(p=1.0, topic="d:*", channel="direct",
+                             mode="replay")]),
+    )
+    got = []
+    fabric.transport().direct.listen("d:1", lambda d: got.append(d))
+    ft.direct.send("d:1", b"round-1")  # nothing captured yet: flows clean
+    ft.direct.send("d:1", b"round-2")  # replaced by the stale round-1
+    _drain(fabric)
+    assert got == [b"round-1", b"round-1"]
+
+
+def test_tamper_inbound_corrupts_before_handler(fabric):
+    ft = FaultyTransport(
+        fabric.transport(), "n",
+        FaultPlan(3, [tamper(p=1.0, topic="p:*", channel="pubsub",
+                             direction="in", mode="flip")]),
+    )
+    got = []
+    ft.pubsub.subscribe("p:*", lambda d: got.append(d))
+    sent = b"inbound-payload-bytes"
+    fabric.transport().pubsub.publish("p:1", sent)
+    _drain(fabric)
+    (delivered,) = got
+    assert delivered != sent and len(delivered) == len(sent)
+
+
+def test_tamper_schedule_deterministic_across_runs():
+    def run(seed):
+        fabric = LoopbackFabric()
+        try:
+            ft = FaultyTransport(
+                fabric.transport(), "n",
+                FaultPlan(seed, [tamper(p=0.5, topic="t:*",
+                                        channel="pubsub", mode="flip")]),
+            )
+            got = []
+            fabric.transport().pubsub.subscribe("t:*", lambda d: got.append(d))
+            for i in range(40):
+                ft.pubsub.publish(f"t:{i % 4}", b"m-%d" % i)
+            fabric.drain(timeout_s=30)
+            return sorted(got), ft.stats.canonical_schedule()
+        finally:
+            fabric.close()
+
+    got_a, sched_a = run(21)
+    got_b, sched_b = run(21)
+    assert got_a == got_b and sched_a == sched_b
+    assert sched_a  # p=0.5 over 40 messages: some fired
+    got_c, sched_c = run(22)
+    assert sched_c != sched_a
 
 
 # -- deterministic transcripts ----------------------------------------------

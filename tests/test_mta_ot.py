@@ -98,6 +98,49 @@ def test_engine_sign_with_ot_mta(monkeypatch):
         ), i
 
 
+def test_engine_sign_cheater_raises_cohort_abort(monkeypatch):
+    """Full GG18 batch signing with a cheating leg (ISSUE 16): one
+    tampered OT wire field in one lane must surface as CohortAbort
+    naming exactly the deviating (lane, party, check) — and the same
+    engine signs cleanly again once the deviation stops (fresh
+    extension counter, verdicts reset per invocation)."""
+    import mpcium_tpu.engine.gg18_batch as gb
+    from mpcium_tpu.core import hostmath as hm
+    from mpcium_tpu.engine.abort import CohortAbort
+
+    monkeypatch.setenv("MPCIUM_MTA", "ot")
+    B = 2
+    ids = ["node0", "node1"]
+    shares = gb.dealer_keygen_secp_batch(B, ids, threshold=1)
+    signer = gb.GG18BatchCoSigners(ids, shares, preparams={})
+    # leg (0, 1): Alice = node0 (receiver, choice bits k_0), Bob =
+    # node1 (sender). Corrupt Bob's Gilboa opening for lane 1 → the
+    # gilboa check must blame node1 on lane 1, and lane 0 stays clean.
+    signer.ot_legs[(0, 1)].set_tamper(
+        {"field": "D", "lane": 1, "set": 0, "byte": 3}
+    )
+    digests = np.frombuffer(
+        secrets.token_bytes(B * 32), np.uint8
+    ).reshape(B, 32)
+    with pytest.raises(CohortAbort) as exc:
+        signer.sign(digests)
+    assert exc.value.culprits == [(1, "node1", "gilboa")]
+    assert exc.value.lanes() == [1]
+
+    # cheater stops: the SAME engine instance completes honestly
+    signer.ot_legs[(0, 1)].set_tamper(None)
+    out = signer.sign(digests)
+    assert out["ok"].all()
+    for i in range(B):
+        pub = hm.secp_decompress(shares[0][i].public_key)
+        assert hm.ecdsa_verify(
+            pub,
+            int.from_bytes(digests[i].tobytes(), "big"),
+            int.from_bytes(out["r"][i].tobytes(), "big"),
+            int.from_bytes(out["s"][i].tobytes(), "big"),
+        ), i
+
+
 def test_run_multi_shared_extension():
     """run_multi: one extension, two payload sets against the same
     Alice scalar (the GG18 k·gamma / k·w pairing). Both products

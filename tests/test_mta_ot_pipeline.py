@@ -185,8 +185,10 @@ def test_wire_version_mismatch_fails_loudly():
     message with no version field at all) is rejected with a clear
     error instead of unmasking garbage pads."""
     leg = synth_leg(3)
-    a = _limbs([3, 5])
-    b = _limbs([7, 11])
+    # B=4 like every other tier-1 OT fixture: the full wire rounds run
+    # the check kernels, which must stay inside the shared compile family
+    a = _limbs([3, 5, 9, 12])
+    b = _limbs([7, 11, 13, 15])
     msg_a = leg.alice_round1(a, 0)
     assert msg_a["v"] == mta_ot.OT_WIRE_VERSION
 
@@ -204,7 +206,7 @@ def test_wire_version_mismatch_fails_loudly():
         leg.alice_round3_multi(stripped)
     # and the well-versioned message still flows
     (alpha,) = leg.alice_round3_multi(msgs_b)
-    assert np.asarray(alpha).shape[0] == 2
+    assert np.asarray(alpha).shape[0] == 4
 
 
 def test_resolve_chunks(monkeypatch):

@@ -1,0 +1,197 @@
+"""Active-security checks on OT-MtA (ISSUE 16 tentpole): the KOS
+correlation check, the Gilboa ψ-encoding check and the MtA
+output-consistency check must catch EVERY wire corruption an active
+cheater can apply — blaming exactly the deviating party on exactly the
+deviating batch lane (identifiable abort, no misattribution) — while
+honest transcripts with checks on stay valid and checks off
+(MPCIUM_OT_CHECKS=0) degrades to the passive protocol, loudly
+incompatible with a checking peer.
+
+Base OTs are synthesized from their postcondition like
+test_mta_ot_pipeline.py; tags are 8 bytes and B = 4 so every case lands
+in the tier-1 compile family. The engine raising CohortAbort from these
+verdicts is covered in test_mta_ot.py (slow); the scheduler quarantine
+in test_cohort_quarantine.py.
+
+Named ``test_tamper_*`` (after the fault-rule family) rather than
+``test_mta_ot_*`` deliberately: pytest runs tiers alphabetically, and
+this file's shared secp-ladder jit units are the most expensive cold
+compile in tier-1 (~70 s on a bare CPU host). Sorting it after the
+broad protocol/scheduler coverage keeps a cold, time-boxed tier-1 run
+spending its budget on the wide suite first and the EC-heavy
+adversarial tail last."""
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpcium_tpu.core import bignum as bn
+from mpcium_tpu.core.bignum import P256
+from mpcium_tpu.protocol.ecdsa import mta_ot
+
+Q = mta_ot.Q
+B = 4
+
+
+class DetRng:
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.ctr = 0
+
+    def token_bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += hashlib.sha256(
+                b"advrng|%d|%d" % (self.seed, self.ctr)
+            ).digest()
+            self.ctr += 1
+        return bytes(out[:n])
+
+    def randbelow(self, n: int) -> int:
+        return int.from_bytes(self.token_bytes(40), "big") % n
+
+
+def synth_leg(seed: int) -> mta_ot.OTMtALeg:
+    rng = DetRng(seed)
+    leg = mta_ot.OTMtALeg.__new__(mta_ot.OTMtALeg)
+    leg.tag = b"t-advs|%d" % seed  # 8 bytes: tier-1 compile family
+    leg.rng = DetRng(seed + 1000)
+    leg.ctr = 0
+    leg.k0 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.k1 = np.frombuffer(
+        rng.token_bytes(mta_ot.KAPPA * 32), np.uint8
+    ).reshape(-1, 32).copy()
+    leg.delta = np.frombuffer(rng.token_bytes(mta_ot.KAPPA), np.uint8) & 1
+    leg.keysD = np.where(leg.delta[:, None].astype(bool), leg.k1, leg.k0)
+    leg.delta_packed = mta_ot._pack(leg.delta)
+    leg._delta_rows = np.nonzero(leg.delta)[0]
+    return leg
+
+
+def _limbs(vals):
+    return jnp.asarray(bn.batch_to_limbs(vals, P256))
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    # nonzero Bob-side scalars: b ≡ 0 makes B = b·G the identity, whose
+    # SEC1 encoding the openings reject (the 2^-256 caveat SECURITY.md
+    # documents); a = 0 stays fair game for Alice
+    r = DetRng(13)
+    a = [r.randbelow(Q) for _ in range(B)]
+    g = [r.randbelow(Q - 1) + 1 for _ in range(B)]
+    w = [r.randbelow(Q - 1) + 1 for _ in range(B)]
+    a[0] = 0
+    return a, g, w
+
+
+# Every wire field an active cheater controls, the party that owns it,
+# and the check that must catch its corruption. KOS failures blame
+# Alice (she owns the extension matrix and its tags); payload/opening
+# failures blame Bob. One distinct lane per case: no misattribution
+# means the OTHER three lanes stay clean every time.
+CASES = [
+    ("U", None, "alice", mta_ot.CHECK_KOS),
+    ("kos_xbar", None, "alice", mta_ot.CHECK_KOS),
+    ("kos_tbar", None, "alice", mta_ot.CHECK_KOS),
+    ("y0", 0, "bob", mta_ot.CHECK_GILBOA),
+    ("y1", 1, "bob", mta_ot.CHECK_GILBOA),
+    ("D", 0, "bob", mta_ot.CHECK_GILBOA),
+    ("B_pt", 1, "bob", mta_ot.CHECK_GILBOA),
+    ("Beta_pt", 0, "bob", mta_ot.CHECK_CONSISTENCY),
+]
+
+
+@pytest.mark.parametrize(
+    "field,set_idx,party,check", CASES,
+    ids=[c[0] for c in CASES],
+)
+def test_cheater_caught_and_blamed(field, set_idx, party, check, inputs):
+    a, g, w = inputs
+    lane = CASES.index((field, set_idx, party, check)) % B
+    leg = synth_leg(1)
+    spec = {"field": field, "lane": lane, "byte": 7, "xor": 0x40}
+    if set_idx is not None:
+        spec["set"] = set_idx
+    leg.set_tamper(spec)
+    leg.run_multi(_limbs(a), (_limbs(g), _limbs(w)))
+    blames = leg.check_blame()
+    assert blames is not None, "checks on but no verdicts collected"
+    assert blames[lane] == (party, check), (
+        f"tampered {field} lane {lane}: expected blame "
+        f"({party}, {check}), got {blames[lane]}"
+    )
+    others = [bl for i, bl in enumerate(blames) if i != lane]
+    assert others == [None] * (B - 1), (
+        f"honest lanes misblamed: {blames}"
+    )
+
+
+def test_honest_run_all_verdicts_clean_and_shares_valid(inputs):
+    """Checks on, no deviation: every verdict true, blame empty, and
+    the MtA relation α + β ≡ a·b holds on every lane — on the wire
+    three-round composition AND the fused run_multi, whose verdicts
+    must agree (same kernels, same tensors)."""
+    a, g, w = inputs
+    leg = synth_leg(2)
+    msg_a = leg.alice_round1(_limbs(a), 0)
+    msgs_b, betas = leg.bob_round2_multi((_limbs(g), _limbs(w)), msg_a, 0)
+    alphas = leg.alice_round3_multi(msgs_b)
+    wire_blames = leg.check_blame()
+    assert wire_blames == [None] * B
+    assert set(leg.check_verdicts) == {"kos", "gilboa", "consistency"}
+    assert all(np.asarray(v).all() for v in leg.check_verdicts.values())
+    for al, be, b_ints in zip(alphas, betas, (g, w)):
+        ai = bn.batch_from_limbs(np.asarray(al), P256)
+        bi = bn.batch_from_limbs(np.asarray(be), P256)
+        for i in range(B):
+            assert (ai[i] + bi[i]) % Q == a[i] * b_ints[i] % Q, i
+
+    leg2 = synth_leg(2)
+    leg2.run_multi(_limbs(a), (_limbs(g), _limbs(w)))
+    assert leg2.check_blame() == [None] * B
+
+
+def test_checks_off_escape_hatch(monkeypatch, inputs):
+    """MPCIUM_OT_CHECKS=0: the passive protocol — no verdicts, no
+    blame, no check fields on the wire — and shares still correct."""
+    monkeypatch.setenv("MPCIUM_OT_CHECKS", "0")
+    a, g, w = inputs
+    leg = synth_leg(3)
+    msg_a = leg.alice_round1(_limbs(a), 0)
+    assert "kos_xbar" not in msg_a and "kos_tbar" not in msg_a
+    msgs_b, betas = leg.bob_round2_multi((_limbs(g),), msg_a, 0)
+    assert "D" not in msgs_b[0] and "B_pt" not in msgs_b[0]
+    (alpha,) = leg.alice_round3_multi(msgs_b)
+    assert leg.check_blame() is None
+    ai = bn.batch_from_limbs(np.asarray(alpha), P256)
+    bi = bn.batch_from_limbs(np.asarray(betas[0]), P256)
+    for i in range(B):
+        assert (ai[i] + bi[i]) % Q == a[i] * g[i] % Q, i
+
+
+def test_unchecked_peer_rejected_loudly(inputs):
+    """A v3 message missing its check fields (a peer running
+    MPCIUM_OT_CHECKS=0 against a checking party) fails with a clear
+    contract error, never silently skipping verification."""
+    a, g, _w = inputs
+    leg = synth_leg(4)
+    msg_a = leg.alice_round1(_limbs(a), 0)
+    stripped_a = {
+        k: v for k, v in msg_a.items()
+        if k not in ("kos_xbar", "kos_tbar")
+    }
+    with pytest.raises(ValueError, match="no KOS tags"):
+        leg.bob_round2_multi((_limbs(g),), stripped_a, 0)
+    msgs_b, _betas = leg.bob_round2_multi((_limbs(g),), msg_a, 0)
+    stripped_b = [
+        {k: v for k, v in m.items()
+         if k not in ("D", "B_pt", "Beta_pt")}
+        for m in msgs_b
+    ]
+    with pytest.raises(ValueError, match="no Gilboa opening"):
+        leg.alice_round3_multi(stripped_b)

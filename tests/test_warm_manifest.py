@@ -48,13 +48,13 @@ def test_enumeration_is_knobs_times_buckets(surface, knobs):
     assert by_engine == {
         "eddsa.sign": nb,            # B × {q}
         "dkg.run": nb * 2,           # B × {q} × {ed25519, secp256k1}
-        "gg18.sign": nb,             # B × {q} × {mta_impl}
+        "gg18.sign": nb * 2,         # B × {q} × {paillier, ot}
         "party.dkg": nb * 2,
         "party.ecdsa": nb,
         "party.reshare": nb * 2,     # B × {q} × key_type × {t_new}
         "reshare.run": nb * 2,       # B × key_type × {t_new}
     }
-    assert man["counts"]["entries"] == 11 * nb
+    assert man["counts"]["entries"] == 12 * nb
     assert man["gaps"] == []
 
 
@@ -162,6 +162,16 @@ def test_knobs_from_config_follow_threshold():
     knobs = wm.knobs_from_config(cfg)
     assert knobs.q == (3,)
     assert knobs.t_new == (2,)
+
+
+def test_default_knobs_always_include_ot_backend(monkeypatch):
+    """ISSUE 16: the OT backend's check kernels must be enumerated (and
+    so pre-warmed) no matter which MtA backend the node serves today —
+    deduped when the node already serves ot."""
+    monkeypatch.delenv("MPCIUM_MTA", raising=False)
+    assert wm.default_knobs().mta_impl == ("paillier", "ot")
+    monkeypatch.setenv("MPCIUM_MTA", "ot")
+    assert wm.default_knobs().mta_impl == ("ot",)
 
 
 def test_report_basename_is_stable():
