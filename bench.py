@@ -42,7 +42,9 @@ gg18_ot_mta_host_s / gg18_ot_mta_device_s / gg18_ot_mta_overlap_ratio.
 The host-only extension-stage microbench is scripts/bench_ot_host.py.
 
 Batch sweep: MPCIUM_BENCH_B_SWEEP="1024,4096,8192" appends a final
-merged line whose "b_sweep" maps each batch size to either the measured
+merged line; unset on TPU it defaults to the DEFAULT_B_SWEEP ladder
+("1024,4096,8192,16384" — ISSUE 17 adds the 16384 bucket), and
+MPCIUM_BENCH_B_SWEEP=none disables. "b_sweep" maps each batch size to either the measured
 sigs/sec or a STRUCTURED DNF — {"dnf": true, "reason": "..."} — never a
 bare prose string (the BENCH_TPU_OT B=8192 entry predates this and is
 flagged by the ledger as unstructured). Each size runs in a fresh
@@ -365,6 +367,7 @@ def main() -> None:
     # back into the legacy table shape by phase_share().
     phases: dict = {}
     profiled_s = 0.0
+    idle_fraction = 0.0
     if platform == "tpu":
         from mpcium_tpu.perf import profile as perf_profile
         from mpcium_tpu.utils import tracing
@@ -384,6 +387,11 @@ def main() -> None:
             tracing.disable()
         assert out["ok"].all()
         phases = tracing.phase_share(spans)
+        # span-derived pipeline health: fraction of the profiled window
+        # with NO device phase in flight (ISSUE 17 zero-idle target);
+        # kept out of phase_s so the 2-decimal rounding there cannot
+        # flatten a small idle share to 0.00
+        idle_fraction = tracing.device_idle_fraction(spans)
         if profiling:
             # fold per-phase device-op seconds from the captured profile
             # into the phase table (keys <phase>_device_op_s)
@@ -397,6 +405,8 @@ def main() -> None:
         assert out["ok"].all()
     elapsed = time.perf_counter() - t0
 
+    from mpcium_tpu.engine.pipeline import resolve_cohorts
+
     sigs_per_sec = runs * B / elapsed
     record = {
         "metric": "secp256k1_2of3_gg18_sigs_per_sec",
@@ -405,11 +415,13 @@ def main() -> None:
         "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 4),
         "platform": platform,
         "batch": B,
+        "pipeline_cohorts": resolve_cohorts(B),
         "runs": runs,
         "mta": os.environ.get("MPCIUM_MTA", "paillier"),
         "setup_s": round(setup_s, 1),
         "compile_s": round(compile_s, 1),
         "profiled_run_s": round(profiled_s, 1),
+        "device_idle_fraction": round(idle_fraction, 4),
         "phase_s": {k: round(v, 2) for k, v in phases.items()},
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
     }
@@ -502,6 +514,9 @@ def main() -> None:
             record["gg18_ot_mta_phase_s"] = {
                 k: round(v, 3) for k, v in phases_ot.items()
             }
+            record["gg18_ot_mta_device_idle_fraction"] = round(
+                tracing.device_idle_fraction(spans_ot), 4
+            )
             record["gg18_ot_mta_host_s"] = round(
                 phases_ot.get("r2_mta_ot_host", 0.0), 3
             )
@@ -609,12 +624,23 @@ def _b_sweep_entry(bsz: int, timeout_s: float) -> object:
     }
 
 
+# Default sweep on TPU when MPCIUM_BENCH_B_SWEEP is unset: the ladder the
+# perf ledger tracks round over round, now topped by the 16384 bucket
+# (ISSUE 17). A size that wedges or times out lands as a structured DNF
+# via _b_sweep_entry — never a missing key or a bare prose string.
+DEFAULT_B_SWEEP = "1024,4096,8192,16384"
+
+
 def _run_b_sweep(record: dict) -> None:
     """MPCIUM_BENCH_B_SWEEP: comma-separated batch sizes, each timed in
     its own subprocess; results land under record["b_sweep"] keyed by
-    batch size, as numbers or structured DNFs."""
+    batch size, as numbers or structured DNFs. Unset on TPU → the
+    DEFAULT_B_SWEEP ladder; "0"/"none" disables. The degraded CPU path
+    never sweeps by default (each point re-pays a multi-minute compile)."""
     spec = os.environ.get("MPCIUM_BENCH_B_SWEEP", "").strip()
-    if not spec:
+    if not spec and record.get("platform") == "tpu":
+        spec = DEFAULT_B_SWEEP
+    if not spec or spec.lower() in ("0", "none"):
         return
     _STATE["stage"] = "b_sweep"
     timeout_s = float(os.environ.get(

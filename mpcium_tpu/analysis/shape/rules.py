@@ -19,10 +19,15 @@ MPS903  a jit body closing over a module-level np./jnp. array of
 MPS904  dtype instability: the same traced jit parameter receives
         explicitly different dtypes across call sites — each distinct
         dtype is a separate compile of the same kernel.
-MPS905  vmap-axis/donation misuse: non-constant ``in_axes``/
-        ``out_axes`` (a fresh axes spec is a fresh jaxpr), or a donated
-        argument read after the donating call (donation invalidates the
-        buffer).
+MPS905  vmap-axis misuse: non-constant ``in_axes``/``out_axes`` — a
+        fresh axes spec is a fresh jaxpr.
+MPS906  use-after-donate: a jit callee with ``donate_argnums`` whose
+        caller reads the donated argument after the call site —
+        donation invalidates the buffer. Rebinding-aware: the carried
+        round-state pattern ``st = round_step(st)`` (engine pipeline,
+        ISSUE 17) re-binds the name at the call, so later reads see the
+        fresh value and are NOT flagged; only reads with no intervening
+        rebind are.
 
 All findings carry mpclint's line-number-free fingerprints and flow
 through the shared baseline; ``# mpclint: disable=MPS90x`` suppressions
@@ -374,6 +379,27 @@ def check_vmap_donation(index: ProjectIndex, graph: CallGraph,
                             f"— every distinct axes spec traces a fresh "
                             f"jaxpr; use literal axes",
                         )
+
+
+# -- MPS906 ------------------------------------------------------------------
+
+
+def check_use_after_donate(index: ProjectIndex, graph: CallGraph,
+                           inventory: JitInventory) -> Iterator[Finding]:
+    """Use-after-donate, rebinding-aware. The donated-round-state
+    engines chain ``st = round_step(st)``: the assignment re-binds the
+    name at the call line, so every later read sees the step's OUTPUT
+    pytree, not the donated input buffer — those are clean. A read of
+    the donated name with NO intervening rebind is a live bug: XLA may
+    already have reused the buffer."""
+    for fid, fi in sorted(index.functions.items()):
+        stores: Dict[str, List[int]] = {}
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                stores.setdefault(n.id, []).append(n.lineno)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
             entry = inventory.resolve_call(graph, fi, node)
             if entry is None or not entry.donate:
                 continue
@@ -394,19 +420,28 @@ def check_vmap_donation(index: ProjectIndex, graph: CallGraph,
                         and later.id == name_node.id
                         and later.lineno > node.lineno
                     ):
+                        if any(
+                            node.lineno <= r < later.lineno
+                            for r in stores.get(later.id, ())
+                        ):
+                            # re-bound between the donating call and
+                            # this read — the name now holds the step's
+                            # output, not the donated buffer
+                            continue
                         yield _finding(
-                            "MPS905", fi, later.lineno,
+                            "MPS906", fi, later.lineno,
                             f"{entry.name}:{pname}:donated-reuse",
                             f"{name_node.id!r} is donated to jit entry "
                             f"{entry.name!r} (param {pname!r}) but read "
-                            f"afterwards — donation invalidates the "
-                            f"buffer; drop the later read or the "
-                            f"donation",
+                            f"afterwards with no rebind — donation "
+                            f"invalidates the buffer; rebind the name "
+                            f"(st = step(st)), drop the later read, or "
+                            f"drop the donation",
                         )
                         break
 
 
-RULE_IDS = ("MPS901", "MPS902", "MPS903", "MPS904", "MPS905")
+RULE_IDS = ("MPS901", "MPS902", "MPS903", "MPS904", "MPS905", "MPS906")
 
 
 def run_rules(index: ProjectIndex, graph: CallGraph,
@@ -418,6 +453,7 @@ def run_rules(index: ProjectIndex, graph: CallGraph,
     findings.extend(check_large_closure_constants(index, inventory))
     findings.extend(check_dtype_instability(index, graph, inventory))
     findings.extend(check_vmap_donation(index, graph, inventory))
+    findings.extend(check_use_after_donate(index, graph, inventory))
     # central suppression + fingerprint dedupe (mirrors lint_parsed)
     by_rel = {pf.rel: pf for pf in index.files}
     out: List[Finding] = []

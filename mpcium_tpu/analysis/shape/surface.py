@@ -28,7 +28,7 @@ import json
 import re
 from typing import Dict, List, Optional, Sequence
 
-from ...engine.buckets import is_bucket
+from ...engine.buckets import BUCKETS, is_bucket
 from .jits import JitEntry
 from .sigs import BeginSite
 
@@ -64,6 +64,10 @@ def build_surface(sites: Sequence[BeginSite],
             "ROADMAP-item-4 AOT pre-warmer compiles exactly these "
             "signatures. Regenerate with scripts/mpcshape_surface.py."
         ),
+        # the concrete pow-2 grid every "bucketed" dim ranges over —
+        # embedded so a BUCKETS change (a new top size) is byte-drift in
+        # this file and forces a surface + warm-manifest regen
+        "bucket_grid": list(BUCKETS),
         "engines": engines,
         "jit_entries": jits,
         "counts": {

@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import wire
 from ..engine.buckets import floor_bucket
+from ..engine.pipeline import resolve_cohorts
 from ..node.node import Node, NotEnoughParticipants
 from ..node.session import Session
 from ..protocol.base import KeygenShare, ProtocolError
@@ -216,7 +217,8 @@ def _entry_key(kind: str, msg) -> Tuple[str, str]:
 
 
 def _manifest_body(
-    batch_id: str, leader: str, requests: List[dict], kind: str
+    batch_id: str, leader: str, requests: List[dict], kind: str,
+    cohorts: int = 1,
 ) -> bytes:
     return wire.canonical_json(
         {
@@ -224,6 +226,7 @@ def _manifest_body(
             "leader": leader,
             "requests": requests,
             "kind": kind,
+            "cohorts": cohorts,
         }
     )
 
@@ -858,7 +861,8 @@ class BatchSigningScheduler:
                      node=self.node.node_id)
             threading.Thread(
                 target=self._run_guarded,
-                args=("sign", self._run_batch, child, chunk),
+                args=("sign", self._run_batch, child, chunk,
+                      resolve_cohorts(len(chunk))),
                 kwargs={"inherited": inherited},
                 name=f"bsign-{child}", daemon=True,
             ).start()
@@ -990,12 +994,21 @@ class BatchSigningScheduler:
                 {"msg": e.msg.to_json(), "reply": e.reply_topic}
                 for e in entries
             ]
-            body = _manifest_body(batch_id, self.node.node_id, requests, kind)
+            # cohort-aligned manifest: the chunk is a bucket, and the
+            # advertised counter-phase cohort count keeps every cohort
+            # slice (chunk ÷ K) on the bucket grid too, so engines reuse
+            # prewarmed compiles at any K (engine/pipeline.resolve_cohorts
+            # falls back toward K=1 rather than leave the grid)
+            cohorts = resolve_cohorts(len(entries))
+            body = _manifest_body(
+                batch_id, self.node.node_id, requests, kind, cohorts
+            )
             manifest = {
                 "batch_id": batch_id,
                 "leader": self.node.node_id,
                 "requests": requests,
                 "kind": kind,
+                "cohorts": cohorts,
                 "sig": self.node.identity.sign_raw(body).hex(),
             }
             self.transport.pubsub.publish(
@@ -1111,6 +1124,7 @@ class BatchSigningScheduler:
             sig = bytes.fromhex(man["sig"])
             requests = man["requests"]
             kind = man.get("kind", "sign")
+            cohorts = int(man.get("cohorts", 1))
             msg_cls = {
                 "kg": wire.GenerateKeyMessage,
                 "rs": wire.ResharingMessage,
@@ -1124,20 +1138,26 @@ class BatchSigningScheduler:
             return
         if not reqs:
             return
+        # the cohort count is leader-advertised but engine-clamped: an
+        # off-grid K degrades to the serial oracle, it cannot force a
+        # foreign compile shape (resolve_cohorts re-validates against B)
+        cohorts = resolve_cohorts(len(reqs), cohorts)
         # leader authenticity: must be signed by the node it claims to be
         # from, and that node must be a MEMBER of the wallets' topology
         # (checked against OUR keyinfo below; rank decides who sends, not
         # who is accepted — deputy takeover depends on that)
-        body = _manifest_body(batch_id, leader, requests, kind)
+        body = _manifest_body(
+            batch_id, leader, requests, kind, int(man.get("cohorts", 1))
+        )
         if not self.node.identity.verify_peer(leader, body, sig):
             log.warn("batch manifest with BAD leader signature dropped",
                      batch=batch_id)
             return
         if kind == "kg":
-            self._on_keygen_manifest(batch_id, leader, reqs)
+            self._on_keygen_manifest(batch_id, leader, reqs, cohorts)
             return
         if kind == "rs":
-            self._on_reshare_manifest(batch_id, leader, reqs)
+            self._on_reshare_manifest(batch_id, leader, reqs, cohorts)
             return
         # leadership is rank-based with deputy takeover (_acting_leader):
         # any MEMBER of the wallet topology may lead; signatures and
@@ -1184,7 +1204,7 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("sign", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("sign", self._run_batch, batch_id, reqs),
+            args=("sign", self._run_batch, batch_id, reqs, cohorts),
             kwargs={"inherited": inherited},
             name=f"bsign-{batch_id}", daemon=True,
         ).start()
@@ -1307,7 +1327,9 @@ class BatchSigningScheduler:
 
     # -- batched DKG (kind == "kg") ------------------------------------------
 
-    def _on_keygen_manifest(self, batch_id: str, leader: str, reqs) -> None:
+    def _on_keygen_manifest(
+        self, batch_id: str, leader: str, reqs, cohorts: int = 1
+    ) -> None:
         node = self.node
         # rank-based leadership with deputy takeover: any cluster member
         # may lead (signatures + content checks carry the trust)
@@ -1324,13 +1346,14 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("kg", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("kg", self._run_keygen_batch, batch_id, reqs),
+            args=("kg", self._run_keygen_batch, batch_id, reqs, cohorts),
             kwargs={"inherited": inherited},
             name=f"bdkg-{batch_id}", daemon=True,
         ).start()
 
     def _run_keygen_batch(
-        self, batch_id: str, reqs, inherited: List[Tuple[str, str]] = ()
+        self, batch_id: str, reqs, cohorts: int = 1,
+        inherited: List[Tuple[str, str]] = (),
     ) -> None:
         from ..protocol.batch_dkg import BatchedDKGParty
 
@@ -1421,6 +1444,7 @@ class BatchSigningScheduler:
                         else None
                     ),
                     min_paillier_bits=node.min_paillier_bits,
+                    cohorts=cohorts,
                 )
                 sessions.append(
                     Session(
@@ -1496,7 +1520,9 @@ class BatchSigningScheduler:
 
     # -- batched resharing (kind == "rs") ------------------------------------
 
-    def _on_reshare_manifest(self, batch_id: str, leader: str, reqs) -> None:
+    def _on_reshare_manifest(
+        self, batch_id: str, leader: str, reqs, cohorts: int = 1
+    ) -> None:
         node = self.node
         first = reqs[0][0]
         info = node.keyinfo.get(first.key_type, first.wallet_id)
@@ -1527,13 +1553,14 @@ class BatchSigningScheduler:
         inherited = self._inherit_covered("rs", covered)
         threading.Thread(
             target=self._run_guarded,
-            args=("rs", self._run_reshare_batch, batch_id, reqs, info),
+            args=("rs", self._run_reshare_batch, batch_id, reqs, info,
+                  cohorts),
             kwargs={"inherited": inherited},
             name=f"brs-{batch_id}", daemon=True,
         ).start()
 
     def _run_reshare_batch(
-        self, batch_id: str, reqs, info, inherited=()
+        self, batch_id: str, reqs, info, cohorts: int = 1, inherited=()
     ) -> None:
         from ..node.node import share_key
         from ..protocol.batch_dkg import BatchedReshareParty
@@ -1613,6 +1640,7 @@ class BatchSigningScheduler:
                 ),
                 min_paillier_bits=node.min_paillier_bits,
                 old_epoch=info.epoch,
+                cohorts=cohorts,
             )
         except (ProtocolError, NotEnoughParticipants) as e:
             log.warn("batched reshare not runnable", batch=batch_id,
@@ -1707,6 +1735,7 @@ class BatchSigningScheduler:
         self,
         batch_id: str,
         reqs: List[Tuple[wire.SignTxMessage, str]],
+        cohorts: int = 1,
         inherited: List[Tuple[str, str]] = (),
     ) -> None:
         node = self.node
@@ -1767,11 +1796,12 @@ class BatchSigningScheduler:
                 party = BatchedECDSASigningParty(
                     f"bsign:{batch_id}", node.node_id, quorum, shares,
                     messages, dom=self.gg18_dom or Domains(),
+                    cohorts=cohorts,
                 )
             else:
                 party = BatchedEDDSASigningParty(
                     f"bsign:{batch_id}", node.node_id, quorum, shares,
-                    messages,
+                    messages, cohorts=cohorts,
                 )
         except (ProtocolError, NotEnoughParticipants) as e:
             log.warn("batch not signable here — waiting for redelivery",

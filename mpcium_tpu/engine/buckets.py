@@ -17,7 +17,11 @@ must not pull jax.
 """
 from __future__ import annotations
 
-BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+# 16384 entered the grid with the donated-round-state pipeline (ISSUE
+# 17): donate_argnums on per-round session state halves peak HBM per
+# round step, which is exactly the headroom the biggest bucket needs.
+BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+           16384)
 
 _BUCKET_SET = frozenset(BUCKETS)
 

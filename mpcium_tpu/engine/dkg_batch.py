@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import functools
 import secrets
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,7 @@ from ..ops.sha256 import sha256 as dev_sha256
 from ..perf import compile_watch
 from ..protocol.base import KeygenShare, party_xs
 from ..utils import tracing
+from . import pipeline as pl
 
 
 def _trace_sync(tensors) -> None:
@@ -156,6 +157,99 @@ def _verify_phase_points(subshares, pts, key_type: str, xs):
     return ok
 
 
+def _vss_core(
+    engine: str,
+    key_type: str,
+    xs_tuple: Tuple[int, ...],
+    coeffs: jnp.ndarray,
+    blinds: jnp.ndarray,
+    plan: pl.CohortPlan,
+    _pt: tracing.PhaseTimer,
+):
+    """The shared DKG/reshare round core — commit → subshare → VSS
+    verify → aggregate — run per counter-phase cohort (engine/pipeline).
+
+    All secret material (``coeffs``, ``blinds``) is drawn by the caller
+    for the FULL batch in K=1 serial order before the split; each cohort
+    only ever slices it along the wallet axis, so share values and
+    commitment bytes are bit-identical for every K.
+
+    Returns ``(ok, agg, comp)`` merged back to batch order: ``ok`` a
+    host (B,) verdict row, ``agg`` the aggregated sub-share block
+    (n_recv, B, limbs) pulled device→host once per cohort, and ``comp``
+    the aggregate commitment bytes ``[t+1][B]`` (``comp[0]`` is the
+    public-key row).
+    """
+    mod, _ = _curve(key_type)
+    ring = mod.scalar_ring()
+    q = int(coeffs.shape[0])  # mpcflow: host-ok — static shape metadata, no device readback
+    tp1 = int(coeffs.shape[1])
+
+    def rounds(mark, c_coeffs, c_blinds):
+        pts, _comps, commits = _commit_phase(c_coeffs, c_blinds, key_type)
+        mark("commit", commits)
+        subshares = _subshare_phase(c_coeffs, key_type, xs_tuple)
+        mark("subshare", subshares)
+        ok = _verify_phase_points(subshares, pts, key_type, xs_tuple)
+        mark("vss_verify", ok)
+        agg = subshares[0]
+        for i in range(1, q):
+            agg = ring.addmod(agg, subshares[i])
+        agg_pts = []
+        for kdeg in range(tp1):
+            acc = pts[0][kdeg]
+            for i in range(1, q):
+                acc = mod.add(acc, pts[i][kdeg])
+            agg_pts.append(acc)
+        return ok, agg, agg_pts
+
+    if plan.serial:
+        ok, agg, agg_pts = rounds(_pt.mark, coeffs, blinds)
+        ok_h = np.asarray(ok)  # mpcflow: host-ok — verdict egress
+        agg_h = np.asarray(agg)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
+        comp = [_compress_host(key_type, acc) for acc in agg_pts]
+        return ok_h, agg_h, comp
+
+    cohort_phases = [
+        dict() if _pt.phases is not None else None for _ in range(plan.k)
+    ]
+
+    def make_job(ci: int, sl: slice):
+        def job():
+            cpt = tracing.PhaseTimer(
+                engine, _trace_sync, phase_times=cohort_phases[ci],
+                node="engine", tid=f"{_pt.tid}:c{ci}",
+            )
+            ok, agg, agg_pts = rounds(
+                cpt.mark, coeffs[:, :, sl], blinds[:, sl]
+            )
+            out = yield (
+                "share_egress",
+                lambda: (
+                    np.asarray(ok),  # mpcflow: host-ok — verdict egress
+                    np.asarray(agg),  # mpcflow: host-ok — aggregated shares leave device once per cohort
+                    [_compress_host(key_type, acc) for acc in agg_pts],
+                ),
+            )
+            return out
+
+        return job
+
+    outs = pl.run_counter_phase(
+        [make_job(ci, sl) for ci, sl in enumerate(plan.slices())]
+    )
+    if _pt.phases is not None:
+        for d in cohort_phases:
+            for name, dt in d.items():
+                _pt.phases[name] = _pt.phases.get(name, 0.0) + dt
+    ok_h = pl.merge_rows([o[0] for o in outs])
+    agg_h = pl.merge_rows([o[1] for o in outs], axis=1)
+    comp = [
+        [c for o in outs for c in o[2][kdeg]] for kdeg in range(tp1)
+    ]
+    return ok_h, agg_h, comp
+
+
 class BatchedDKG:
     """In-process q-party Feldman DKG for B wallets (bench/test fabric —
     the distributed node runs one side of the same kernels per party)."""
@@ -176,10 +270,18 @@ class BatchedDKG:
             raise ValueError("need 0 < t < n")
         self.xs = party_xs(self.ids)
 
-    def run(self, n_wallets: int) -> List[List[KeygenShare]]:
+    def run(
+        self, n_wallets: int, cohorts: Optional[int] = None
+    ) -> List[List[KeygenShare]]:
         """Returns per-party share lists (result[i] → party_ids[i]),
-        wallet-aligned. Raises on any VSS/commitment failure."""
-        mod, order = _curve(self.key_type)
+        wallet-aligned. Raises on any VSS/commitment failure.
+
+        ``cohorts`` picks the counter-phase cohort count (see
+        engine/pipeline.resolve_cohorts); shares and commitment bytes
+        are bit-identical for every K because all polynomial
+        coefficients and blinds are drawn full-batch before the split.
+        """
+        _, order = _curve(self.key_type)
         q, t, B = len(self.ids), self.t, n_wallets
         _pt = tracing.PhaseTimer(
             "dkg.run", _trace_sync, node="engine", tid=f"dkg:B{B}",
@@ -195,33 +297,13 @@ class BatchedDKG:
                 self.rng.token_bytes(q * B * 32), dtype=np.uint8
             ).reshape(q, B, 32)
         )
-        pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
-        _pt.mark("commit", commits)
-        # reveal phase is implicit in-process; re-check binding + VSS
-        subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
-        _pt.mark("subshare", subshares)
-        ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
-        _pt.mark("vss_verify", ok)
-        if not bool(np.asarray(ok).all()):
+        plan = pl.CohortPlan.for_batch(B, cohorts)
+        ok, agg_host, agg_comp = _vss_core(
+            "dkg.run", self.key_type, xs_tuple, coeffs, blinds, plan, _pt
+        )
+        if not bool(ok.all()):
             raise RuntimeError("batched DKG: VSS verification failed")
-        # aggregate
-        ring = mod.scalar_ring()
-        agg = subshares[0]
-        for i in range(1, q):
-            agg = ring.addmod(agg, subshares[i])
-        # single device→host pull for the whole (q, B) share block instead
-        # of one np.asarray round-trip per party
-        agg_host = np.asarray(agg)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
         agg_shares = [agg_host[j] for j in range(q)]
-        agg_pts = []
-        for kdeg in range(t + 1):
-            acc = pts[0][kdeg]
-            for i in range(1, q):
-                acc = mod.add(acc, pts[i][kdeg])
-            agg_pts.append(acc)
-        agg_comp = [
-            _compress_host(self.key_type, acc) for acc in agg_pts
-        ]  # (t+1) lists of B byte strings
         pubs = agg_comp[0]
         shares_int = [
             bn.batch_from_limbs(s, P256) for s in agg_shares
@@ -272,11 +354,10 @@ class BatchedReshare:
         if not 0 < new_threshold < len(self.new_committee):
             raise ValueError("need 0 < t_new < |new committee|")
 
-    def run(self) -> List[List[KeygenShare]]:
+    def run(self, cohorts: Optional[int] = None) -> List[List[KeygenShare]]:
         """Returns per-NEW-member share lists; verifies the redeal binds to
-        the old public keys."""
-        mod, order = _curve(self.key_type)
-        ring = mod.scalar_ring()
+        the old public keys. ``cohorts`` as in :meth:`BatchedDKG.run`."""
+        _, order = _curve(self.key_type)
         B, t_new = self.B, self.t_new
         q_old = len(self.old_quorum)
         _pt = tracing.PhaseTimer(
@@ -306,38 +387,22 @@ class BatchedReshare:
                 self.rng.token_bytes(q_old * B * 32), dtype=np.uint8
             ).reshape(q_old, B, 32)
         )
-        pts, comps, commits = _commit_phase(coeffs, blinds, self.key_type)
-        _pt.mark("commit", commits)
-        subshares = _subshare_phase(coeffs, self.key_type, xs_tuple)
-        _pt.mark("subshare", subshares)
-        ok = _verify_phase_points(subshares, pts, self.key_type, xs_tuple)
-        _pt.mark("vss_verify", ok)
+        plan = pl.CohortPlan.for_batch(B, cohorts)
+        ok, agg_host, agg_comp = _vss_core(
+            "reshare.run", self.key_type, xs_tuple, coeffs, blinds, plan, _pt
+        )
 
         # redeal binding: Σ_i C_i0 must equal the old public key
-        pub_sum = pts[0][0]
-        for i in range(1, q_old):
-            pub_sum = mod.add(pub_sum, pts[i][0])
-        pub_comp = _compress_host(self.key_type, pub_sum)
+        pub_comp = agg_comp[0]
         for w in range(B):
             if pub_comp[w] != self.old_shares[0][w].public_key:
                 raise RuntimeError(
                     f"resharing changed the public key for wallet {w}"
                 )
-        if not bool(np.asarray(ok).all()):
+        if not bool(ok.all()):
             raise RuntimeError("batched resharing: VSS verification failed")
 
-        agg = subshares[0]
-        for i in range(1, q_old):
-            agg = ring.addmod(agg, subshares[i])
-        # single device→host pull, mirroring BatchedDKG.run
-        agg_host = np.asarray(agg)  # mpcflow: host-ok — aggregated shares leave device once, for the returned share objects
         agg_shares = [agg_host[j] for j in range(len(self.new_committee))]
-        agg_comp = []
-        for kdeg in range(t_new + 1):
-            acc = pts[0][kdeg]
-            for i in range(1, q_old):
-                acc = mod.add(acc, pts[i][kdeg])
-            agg_comp.append(_compress_host(self.key_type, acc))
         shares_int = [bn.batch_from_limbs(s, P256) for s in agg_shares]
         epoch = first.epoch + 1
         out: List[List[KeygenShare]] = [[] for _ in self.new_committee]

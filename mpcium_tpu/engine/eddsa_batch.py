@@ -26,7 +26,8 @@ from __future__ import annotations
 import hashlib
 import os
 import secrets
-from typing import Sequence, Tuple
+from functools import partial
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -193,6 +194,66 @@ def fused_sign_step(
 
 
 # ---------------------------------------------------------------------------
+# donated round steps (counter-phase cohort pipeline, engine/pipeline.py)
+# ---------------------------------------------------------------------------
+#
+# Per-round session state is an explicit carried pytree and every step
+# DONATES its input state (donate_argnums=(0,)): XLA reuses or frees the
+# previous round's buffers instead of keeping both rounds live, which is
+# the HBM headroom that makes B=16384 viable (engine/buckets.py). The
+# donation contract for callers: rebind, never re-read — ``st =
+# round_step_x(st)``; mpcshape rule MPS906 flags any read of a donated
+# binding after the call site. Chaining step-to-step keeps the state on
+# device with its ingress sharding (to_dev's session axis), so cohort
+# handoffs never reshard.
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def round_step_nonce(st, pref):
+    """R1 as one donated step: ``{r64 (q,B,64), blinds (q,B,32)}`` →
+    ``{r, R_comp, commit_msg, commits}``. Same kernel composition as the
+    unpipelined path (nonce_commitments + device SHA-256 commitments) —
+    bit-identical outputs, one dispatch."""
+    r, R_comp = nonce_commitments(st["r64"])
+    q, B = R_comp.shape[0], R_comp.shape[1]
+    commit_msg = jnp.concatenate(
+        [jnp.broadcast_to(pref, (q, B) + pref.shape), st["blinds"], R_comp],
+        axis=-1,
+    )
+    return {
+        "r": r,
+        "R_comp": R_comp,
+        "commit_msg": commit_msg,
+        "commits": hs.sha256(commit_msg),
+    }
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def round_step_aggregate(st):
+    """R2 as one donated step: re-hash the received commitment tensors
+    (one fraud verdict for the batch) and aggregate the nonce points."""
+    again = hs.sha256(st["commit_msg"])
+    R_sum, ok_R = aggregate_nonce(st["R_comp"])
+    return {
+        "r": st["r"],
+        "R_sum": R_sum,
+        "ok_R": ok_R,
+        "fraud_free": jnp.all(again == st["commits"]),
+    }
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def round_step_partial(st, c64, lamx):
+    """R3 as one donated step: partial signatures + combine."""
+    q = st["r"].shape[0]
+    parts = partial_signature(
+        st["r"], jnp.broadcast_to(c64, (q,) + c64.shape), lamx
+    )
+    sigs, _ = combine_signatures(parts, st["R_sum"])
+    return {"sigs": sigs, "ok_R": st["ok_R"], "R_sum": st["R_sum"]}
+
+
+# ---------------------------------------------------------------------------
 # host helpers
 # ---------------------------------------------------------------------------
 
@@ -335,20 +396,121 @@ class BatchedCoSigners:
         )
         self._A_dev = jnp.asarray(self.A_comp)  # uploaded once, reused every batch
 
-    def sign(self, messages: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    def sign(
+        self, messages: Sequence[bytes], cohorts: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
         """Run the full 3-round protocol for B sessions → ((B, 64)
         signatures, (B,) ok mask). Raises on commitment fraud.
+
+        The batch executes as K counter-phase cohorts (engine/pipeline;
+        ``cohorts=`` overrides MPCIUM_PIPELINE_COHORTS): each cohort's
+        donated round steps dispatch asynchronously while another
+        cohort's host stage (fraud verdict, signature egress) drains on
+        the pipeline worker. ALL nonce/blind bytes are drawn for the
+        full batch, in K=1 serial order, before the split — signatures
+        are bit-identical for every K (tests/test_pipeline.py). The
+        hashlib/native fallback paths (MPCIUM_EDDSA_DEVICE_HASH=0,
+        ragged messages) stay serial.
 
         With mpctrace armed, device-phase spans (``phase:*``) are emitted
         with a sync at each phase boundary; untraced runs take the no-op
         path — no syncs, bit-identical results."""
         assert len(messages) == self.B
         q, B = self.q, self.B
+        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
+        _cw = compile_watch.begin("eddsa.sign", f"B{B}|q{q}")
+
+        # ALL secret randomness precedes the cohort split (transcript
+        # discipline: the rng stream is identical for every K)
+        r64 = np.stack([fresh_nonce_bytes(B, self.rng) for _ in range(q)])
+        blinds = np.stack([
+            np.frombuffer(self.rng.token_bytes(B * 32), dtype=np.uint8)
+            .reshape(B, 32) for _ in range(q)
+        ])
+
+        use_dev_hash = device_hash_enabled()
+        lens = {len(m) for m in messages}
+        if not use_dev_hash or len(lens) != 1:
+            out = self._sign_fallback(messages, r64, blinds, use_dev_hash)
+            compile_watch.finish(_cw)
+            return out
+
+        from . import pipeline as pl
+
+        plan = pl.CohortPlan.for_batch(B, cohorts)
+        Mrows = np.frombuffer(b"".join(messages), np.uint8).reshape(
+            B, lens.pop()
+        )
+        pref = jnp.asarray(
+            np.frombuffer(b"mpcium-tpu/eddsa-commit", np.uint8)
+        )
+
+        def job(ci: int, sl: slice):
+            def run():
+                _pt = tracing.PhaseTimer(
+                    "eddsa.sign", _trace_sync, node="engine",
+                    tid=f"eddsa:B{B}" if plan.serial
+                    else f"eddsa:B{B}:c{ci}",
+                )
+                # donated round-step chain: st stays on device with its
+                # ingress sharding; rebind-only (MPS906)
+                st = {
+                    "r64": to_dev(r64[:, sl], axis=1),
+                    "blinds": to_dev(blinds[:, sl], axis=1),
+                }
+                st = round_step_nonce(st, pref)
+                _pt.mark("r1_nonce_commit", st["commits"])
+                st = round_step_aggregate(st)
+                _pt.mark("r2_decommit_aggregate", st["R_sum"])
+                fraud_free = yield (
+                    "fraud_verdict",
+                    lambda: bool(np.asarray(st["fraud_free"])),  # mpcflow: host-ok — commitment-fraud verdict egress (one bool)
+                )
+                if not fraud_free:
+                    raise RuntimeError("commitment fraud detected")
+                A_c = self._A_dev[sl]
+                c64 = challenge_device(st["R_sum"], A_c, to_dev(Mrows[sl]))
+                st = round_step_partial(
+                    st, c64, to_dev(self.lamx[:, sl], axis=1)
+                )
+                _pt.mark("r3_challenge_partials_combine", st["sigs"])
+                # local verification before publishing (reference
+                # eddsa_signing_session.go:147)
+                ok = verify_signatures(st["sigs"], A_c, c64) & st["ok_R"]
+                _pt.mark("verify", ok)
+                sigs = st["sigs"]
+                out = yield (
+                    "sig_egress",
+                    lambda: (np.asarray(sigs), np.asarray(ok)),  # mpcflow: host-ok — signature egress: final (R,s) + verdicts leave device for callers
+                )
+                return out
+
+            return run
+
+        parts = pl.run_counter_phase(
+            [job(ci, sl) for ci, sl in enumerate(plan.slices())]
+        )
+        out = (
+            pl.merge_rows([p[0] for p in parts]),
+            pl.merge_rows([p[1] for p in parts]),
+        )
+        compile_watch.finish(_cw)
+        return out
+
+    def _sign_fallback(
+        self,
+        messages: Sequence[bytes],
+        r64: np.ndarray,
+        blinds: np.ndarray,
+        use_dev_hash: bool,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The serial (K=1) path for the native/hashlib fallbacks:
+        MPCIUM_EDDSA_DEVICE_HASH=0 and ragged message batches. Same
+        rounds, host hashing, no cohort split."""
+        q, B = self.q, self.B
         _pt = tracing.PhaseTimer(
             "eddsa.sign", _trace_sync, node="engine", tid=f"eddsa:B{B}",
         )
-        # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
-        _cw = compile_watch.begin("eddsa.sign", f"B{B}|q{q}")
 
         # -- round 1: nonce commitments (one (q, B) dispatch) + batch
         # commitments (device SHA-256 over the (q, B) rows where R
@@ -356,13 +518,7 @@ class BatchedCoSigners:
         # C++ per-party calls) ------------------------------------------------
         from .. import native
 
-        r64 = np.stack([fresh_nonce_bytes(B, self.rng) for _ in range(q)])
         r_limbs, R_comp = nonce_commitments(jnp.asarray(r64))  # (q,B,22)/(q,B,32)
-        use_dev_hash = device_hash_enabled()
-        blinds = np.stack([
-            np.frombuffer(self.rng.token_bytes(B * 32), dtype=np.uint8)
-            .reshape(B, 32) for _ in range(q)
-        ])
         if use_dev_hash:
             pref = jnp.asarray(
                 np.frombuffer(b"mpcium-tpu/eddsa-commit", np.uint8)
@@ -432,12 +588,10 @@ class BatchedCoSigners:
         # eddsa_signing_session.go:147) --------------------------------------
         ok = verify_signatures(sigs, self._A_dev, c64)
         _pt.mark("verify", ok)
-        out = (
+        return (
             np.asarray(sigs),  # mpcflow: host-ok — signature egress: final (R,s) leave device for callers
             np.asarray(ok & ok_R),  # mpcflow: host-ok — per-wallet verification verdicts, egress with the signatures
         )
-        compile_watch.finish(_cw)
-        return out
 
 
 def dealer_keygen_batch(

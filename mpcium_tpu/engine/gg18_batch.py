@@ -1078,7 +1078,8 @@ class GG18BatchCoSigners:
     # -- the protocol --------------------------------------------------------
 
     def sign(
-        self, digests: np.ndarray, phase_times: Optional[dict] = None
+        self, digests: np.ndarray, phase_times: Optional[dict] = None,
+        cohorts: Optional[int] = None,
     ) -> Dict[str, np.ndarray]:
         """``digests``: (B, 32) big-endian digests. Returns dict with
         r, s (B, 32 BE bytes), recovery (B,), ok mask (B,).
@@ -1086,7 +1087,13 @@ class GG18BatchCoSigners:
         ``phase_times``: optional dict — when given (or when mpctrace is
         armed), the engine blocks at phase boundaries and records wall
         seconds per protocol phase as ``phase:*`` spans plus the legacy
-        dict (bench diagnostics; adds sync overhead only then)."""
+        dict (bench diagnostics; adds sync overhead only then).
+
+        ``cohorts``: counter-phase cohort count for the signing tail
+        (engine/pipeline; None → MPCIUM_PIPELINE_COHORTS, default 2).
+        Signatures and transcripts are bit-identical for every K —
+        randomness is drawn full-batch in serial order before any
+        split."""
         if self.mta_impl == "none":
             raise RuntimeError(
                 "curve_only signer has no MtA contexts — cannot sign()"
@@ -1200,8 +1207,9 @@ class GG18BatchCoSigners:
                     engine="gg18.sign",
                 )
             out = self._finish_sign(
-                _mark, m, ok, k, gamma, Gamma, Gamma_comp,
+                _pt, m, ok, k, gamma, Gamma, Gamma_comp,
                 g_commit, g_blind, alpha_shares, beta_shares,
+                cohorts=cohorts,
             )
             compile_watch.finish(_cw)
             return out
@@ -1281,25 +1289,118 @@ class GG18BatchCoSigners:
                 )
 
         out = self._finish_sign(
-            _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit, g_blind,
-            alpha_shares, beta_shares,
+            _pt, m, ok, k, gamma, Gamma, Gamma_comp, g_commit, g_blind,
+            alpha_shares, beta_shares, cohorts=cohorts,
         )
         compile_watch.finish(_cw)
         return out
 
     def _finish_sign(
-        self, _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,
+        self, _pt, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,
         g_blind, alpha_shares, beta_shares,
+        cohorts: Optional[int] = None,
     ) -> Dict[str, np.ndarray]:
-        """Shared tail of both MtA implementations: δ/σ assembly, R
-        reconstruction, Schnorr PoKs, the full phase-5 commit–reveal and
-        the in-protocol ECDSA verification."""
+        """Shared tail of both MtA implementations, cohort-pipelined
+        (engine/pipeline): δ/σ assembly, R reconstruction, Schnorr PoKs,
+        the full phase-5 commit–reveal and the in-protocol ECDSA
+        verification. With K>1 each cohort's device rounds dispatch
+        while another cohort's signature egress drains on the pipeline
+        host worker; K=1 is byte-for-byte the old serial path.
+
+        Transcript discipline: ALL tail randomness is drawn here — full
+        batch, in the K=1 serial order (kpok, li, ri, ka, kb, va_blind,
+        ut_blind) — then row-sliced per cohort, so the rng stream and
+        every commitment/signature byte is identical for every K. (The
+        MtA rounds BEFORE this tail always run full-batch: the OT
+        extension's PRF tags are width- and counter-dependent, so
+        splitting them would change transcripts; its own chunk overlap
+        already pipelines that stage.)"""
         B, q = self.B, self.q
+        rand = {
+            "kpok": self._rand_scalars_q(),
+            "li": self._rand_scalars_q(),
+            "ri": self._rand_scalars_q(),
+            "ka": self._rand_scalars_q(),
+            "kb": self._rand_scalars_q(),
+            "va_blind": self._blinds_q(),
+            "ut_blind": self._blinds_q(),
+        }
+        from . import pipeline as pl
+
+        plan = pl.CohortPlan.for_batch(B, cohorts)
+        if plan.serial:
+            r_d, s_d, rec_d, ok_d = self._tail_cohort(
+                _pt.mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,
+                g_blind, alpha_shares, beta_shares, rand,
+                list(self.w), self.Y,
+            )
+            return _sig_egress(r_d, s_d, rec_d, ok_d)
+
+        # per-cohort phase timers: independent spans (tid …:cN) so the
+        # idle meter sees the counter-phase overlap; legacy phase dicts
+        # are summed back into the caller's afterwards
+        cohort_phases = [
+            {} if _pt.phases is not None else None for _ in range(plan.k)
+        ]
+
+        def job(ci: int, sl: slice):
+            def run():
+                pt_c = tracing.PhaseTimer(
+                    "gg18.sign", _trace_sync,
+                    phase_times=cohort_phases[ci],
+                    node="engine", tid=f"gg18:B{B}:c{ci}",
+                )
+                r_d, s_d, rec_d, ok_d = self._tail_cohort(
+                    pt_c.mark,
+                    m[sl], ok[sl],
+                    [x[sl] for x in k],
+                    [x[sl] for x in gamma],
+                    [_slice_pt(p, sl) for p in Gamma],
+                    [x[sl] for x in Gamma_comp],
+                    [x[sl] for x in g_commit],
+                    g_blind[:, sl],
+                    {kk: v[sl] for kk, v in alpha_shares.items()},
+                    {kk: v[sl] for kk, v in beta_shares.items()},
+                    {kk: v[:, sl] for kk, v in rand.items()},
+                    [x[sl] for x in self.w],
+                    _slice_pt(self.Y, sl),
+                )
+                res = yield (
+                    "sig_egress",
+                    lambda: _sig_egress(r_d, s_d, rec_d, ok_d),
+                )
+                return res
+
+            return run
+
+        parts = pl.run_counter_phase(
+            [job(ci, sl) for ci, sl in enumerate(plan.slices())]
+        )
+        if _pt.phases is not None:
+            for d in cohort_phases:
+                for name, v in (d or {}).items():
+                    _pt.phases[name] = _pt.phases.get(name, 0.0) + v
+        return {
+            key: pl.merge_rows([p[key] for p in parts])
+            for key in parts[0]
+        }
+
+    def _tail_cohort(
+        self, _mark, m, ok, k, gamma, Gamma, Gamma_comp, g_commit,
+        g_blind, alpha_shares, beta_shares, rand, w, Y,
+    ):
+        """One cohort's tail rounds over pre-sliced device views —
+        every kernel here is per-lane in B, so a cohort slice computes
+        exactly the rows it would as part of the full batch. Returns
+        DEVICE tensors (r, s, recovery, ok); the host egress is the
+        caller's pipeline stage."""
+        B = int(m.shape[0])
+        q = self.q
         ring = self.ring
         delta_i, sigma_i = [], []
         for i in range(q):
             d = ring.mulmod(k[i], gamma[i])
-            s_ = ring.mulmod(k[i], self.w[i])
+            s_ = ring.mulmod(k[i], w[i])
             for j in range(q):
                 if j == i:
                     continue
@@ -1334,20 +1435,21 @@ class GG18BatchCoSigners:
             Gamma_sum = _blk_point_add(Gamma_sum, Gamma[i])
         ok_R, R_pt, r, rec = _blk_R(delta, Gamma_sum)
         ok = ok & ok_R
-        kpok = self._rand_scalars_q()
+        kpok = rand["kpok"]
         for i in range(q):
             ok = ok & _blk_schnorr(
                 kpok[i], gamma[i], Gamma[i], Gamma_comp[i], _idx_row(i, B)
             )
         _mark("r4_R_reconstruct_pok", ok, r)
 
-        # phase 5A: commitments to V_i, A_i
-        li = self._rand_scalars_q()
-        ri = self._rand_scalars_q()
-        ka = self._rand_scalars_q()
-        kb = self._rand_scalars_q()
-        va_blind = self._blinds_q()
-        ut_blind = self._blinds_q()
+        # phase 5A: commitments to V_i, A_i (randomness pre-drawn by
+        # _finish_sign in serial order — see its transcript note)
+        li = rand["li"]
+        ri = rand["ri"]
+        ka = rand["ka"]
+        kb = rand["kb"]
+        va_blind = rand["va_blind"]
+        ut_blind = rand["ut_blind"]
         s_i, V_i, A_i, V_c, A_c, va_commit = [], [], [], [], [], []
         for i in range(q):
             si, Vi, Ai, vc, ac, cmt = _blk_va(
@@ -1367,7 +1469,7 @@ class GG18BatchCoSigners:
         for i in range(1, q):
             V_sum = _blk_point_add(V_sum, V_i[i])
             A_sum = _blk_point_add(A_sum, A_i[i])
-        V = _blk_V(V_sum, m, r, self.Y)
+        V = _blk_V(V_sum, m, r, Y)
         U_pts, T_pts, U_c, T_c, ut_commit = [], [], [], [], []
         for i in range(q):
             Ui, Ti, uc, tc, cmt = _blk_ut(
@@ -1384,20 +1486,43 @@ class GG18BatchCoSigners:
             U_s = _blk_point_add(U_s, U_pts[i])
             T_s = _blk_point_add(T_s, T_pts[i])
         ok = ok & _blk_point_eq(U_s, T_s)
-        # phase 5E: reveal + combine + verify
+        # phase 5E: reveal + combine + verify — the carried round state
+        # goes through the donated final step (rebind-only: MPS906)
         s = s_i[0]
         for i in range(1, q):
             s = ring.addmod(s, s_i[i])
-        ok_f, s, rec = _blk_final(s, m, r, self.Y, rec)
-        ok = ok & ok_f
-        _mark("r5_phase5_combine_verify", ok, s)
+        st = {"s": s, "m": m, "r": r, "rec": rec, "ok": ok}
+        st = _step_final(st, Y)
+        _mark("r5_phase5_combine_verify", st["ok"], st["s"])
+        return st["r"], st["s"], st["rec"], st["ok"]
 
-        return {
-            "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
-            "s": np.asarray(bn.limbs_to_bytes_le(s, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
-            "recovery": np.asarray(rec),  # mpcflow: host-ok — signature egress
-            "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
-        }
+
+def _slice_pt(pt, sl: slice):
+    """Row-slice a point pytree (NamedTuple of (B, …) leaf arrays) into
+    one cohort's lane view."""
+    return type(pt)(*(leaf[sl] for leaf in pt))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _step_final(st, Y):
+    """Phase-5E combine + in-protocol verify as a DONATED round step:
+    the carried per-round state pytree {s, m, r, rec, ok} is consumed
+    (XLA reuses/frees its buffers — the HBM headroom for B=16384) and
+    replaced by the output state. Callers rebind, never re-read
+    (mpcshape MPS906)."""
+    ok_f, s, rec = _blk_final(st["s"], st["m"], st["r"], Y, st["rec"])
+    return {"r": st["r"], "s": s, "rec": rec, "ok": st["ok"] & ok_f}
+
+
+def _sig_egress(r, s, rec, ok) -> Dict[str, np.ndarray]:
+    """Signature egress: device limbs → host BE bytes. Runs as a
+    pipeline host stage under K>1."""
+    return {
+        "r": np.asarray(bn.limbs_to_bytes_le(r, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
+        "s": np.asarray(bn.limbs_to_bytes_le(s, P256, 32))[:, ::-1].copy(),  # mpcflow: host-ok — signature egress
+        "recovery": np.asarray(rec),  # mpcflow: host-ok — signature egress
+        "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
+    }
 
 
 def dealer_keygen_secp_batch(

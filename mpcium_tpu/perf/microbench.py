@@ -25,6 +25,12 @@ layers a PR can silently slow down without touching a kernel:
   deliberately NOT micro-benched — their shared secp-ladder jit units
   cost ~70 s of cold compile on a bare CPU host, blowing the <30 s
   budget; bench.py's ``gg18_ot_checks_s`` A/B covers them end to end.
+- ``pipeline_handoff``: counter-phase cohort machinery cost (ISSUE 17)
+  — a K=1 and a K=2 pass over no-op stub rounds, timing generator
+  round-robin + executor handoff with zero device work in the way.
+- ``donated_round_step``: warm re-dispatch of a ``donate_argnums``
+  round step with the ``st = step(st)`` rebind the zero-idle pipeline
+  carries through every round.
 
 No TOP-LEVEL jax import: perfcheck must run in <30 s on a bare CPU
 host, so the device rows import jax lazily inside the bench body and
@@ -224,6 +230,68 @@ def ot_kos_check_device(samples: int = DEFAULT_SAMPLES) -> List[float]:
     return _timed_samples(body, samples)
 
 
+def pipeline_handoff(samples: int = DEFAULT_SAMPLES, rounds: int = 32) -> List[float]:
+    """Handoff cost of the counter-phase cohort pipeline (engine/
+    pipeline): one K=1 inline pass and one K=2 overlapped pass over
+    ``rounds`` stub rounds whose device and host stages are no-ops, so
+    the sample times ONLY the machinery — generator round-robin,
+    executor submit, future wait — and a regression in either path
+    (serial oracle or overlap schedule) moves the row."""
+    from ..engine import pipeline as pl
+
+    def make_jobs(k: int):
+        def make_job(ci: int):
+            def job():
+                acc = 0
+                for r in range(rounds):
+                    acc += yield ("stub", lambda r=r: r)
+                return acc
+
+            return job
+
+        return [make_job(ci) for ci in range(k)]
+
+    want = rounds * (rounds - 1) // 2
+
+    def body() -> None:
+        for k in (1, 2):
+            outs = pl.run_counter_phase(make_jobs(k))
+            if outs != [want] * k:  # keep the schedule un-eliminable
+                raise AssertionError("stub pipeline produced wrong sums")
+
+    return _timed_samples(body, samples)
+
+
+def donated_round_step(samples: int = DEFAULT_SAMPLES) -> List[float]:
+    """Warm re-dispatch of a ``donate_argnums`` round step over a
+    signing-shaped state pytree — dict of (16, 8) uint32 planes donated
+    and rebound ``st = step(st)``, the carried-round-state discipline of
+    the zero-idle pipeline (ISSUE 17). CPU usually declines the donation
+    (buffers not usable — warning suppressed here); the row still times
+    the donation-annotated dispatch path the TPU rides."""
+    import functools
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(st):
+        return {k: v + jnp.uint32(1) for k, v in st.items()}
+
+    def body() -> None:
+        st = {k: jnp.zeros((16, 8), jnp.uint32) for k in ("s", "m", "r")}
+        for _ in range(8):
+            st = step(st)
+        jax.block_until_ready(st)
+
+    return _timed_samples(body, samples)
+
+
 ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
     "field_mulmod": field_mulmod,
     "sha256_block": sha256_block,
@@ -233,6 +301,8 @@ ALL_BENCHES: Dict[str, Callable[[int], List[float]]] = {
     "prg_expand_device": prg_expand_device,
     "ot_transpose_device": ot_transpose_device,
     "ot_kos_check_device": ot_kos_check_device,
+    "pipeline_handoff": pipeline_handoff,
+    "donated_round_step": donated_round_step,
 }
 
 

@@ -53,6 +53,7 @@ from ..core import bignum as bn
 from ..core import hostmath as hm
 from ..core.bignum import P256
 from ..core.paillier import PaillierPublicKey, PreParams
+from ..engine import pipeline as pl
 from ..engine.dkg_batch import (
     _blk_vss_check, _curve, _rand_scalars, _subshare_phase, _xj_bits,
 )
@@ -98,6 +99,16 @@ def _blk_deal_commit(coeffs, blind, bind_row, key_type: str):
 def _blk_commit_check(bind_row, blind, block, commit):
     got = dev_sha256(jnp.concatenate([bind_row, blind, block], axis=-1))
     return jnp.all(got == commit, axis=-1)
+
+
+def _concat_pts(parts):
+    """Per-cohort point batches (NamedTuple pytrees of (width, …) leaves)
+    concatenated back to batch order along the lane axis."""
+    if len(parts) == 1:
+        return parts[0]
+    return type(parts[0])(*(
+        jnp.concatenate(leaves, axis=0) for leaves in zip(*parts)
+    ))
 
 
 class _DealingMixin(BatchBlockMixin):
@@ -181,6 +192,7 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
         preparams: Optional[PreParams] = None,
         min_paillier_bits: int = MIN_PAILLIER_BITS,
         rng=None,
+        cohorts: Optional[int] = None,
     ):
         import secrets as _secrets
 
@@ -197,6 +209,7 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
         self.B = n_wallets
         self.pre = preparams
         self.min_paillier_bits = min_paillier_bits
+        self._plan = pl.CohortPlan.for_batch(self.B, cohorts)
         self._stage = 0
 
     def _proof_bind(self, sender: str) -> bytes:
@@ -215,12 +228,37 @@ class BatchedDKGParty(_DealingMixin, PartyBase):
                 self.rng.token_bytes(self.B * 32), dtype=np.uint8
             ).reshape(self.B, 32)
         )
-        self._pts, block, commit = _blk_deal_commit(
-            self._coeffs, self._blind, self._bind_row(self.self_id),
-            self.key_type,
+        # counter-phase dealing (engine/pipeline): coeffs/blinds were
+        # drawn full-batch above in K=1 serial order, so the commitment
+        # block is bit-identical for every cohort count
+        bind = self._bind_row(self.self_id)
+
+        def make_job(ci: int, sl: slice):
+            def job():
+                pts, block, commit = _blk_deal_commit(
+                    self._coeffs[:, sl], self._blind[sl], bind[sl],
+                    self.key_type,
+                )
+                commit_host = yield (
+                    "commit_egress",
+                    lambda: np.asarray(commit),  # mpcflow: host-ok — commitment block leaves device for wire serialization
+                )
+                return pts, block, commit_host
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
         )
-        self._block = block
-        commit_host = np.asarray(commit)  # mpcflow: host-ok — commitment block leaves device for wire serialization
+        self._pts = [
+            _concat_pts([o[0][k] for o in outs]) for k in range(self.tp1)
+        ]
+        self._block = (
+            outs[0][1]
+            if self._plan.serial
+            else jnp.concatenate([o[1] for o in outs], axis=0)
+        )
+        commit_host = np.concatenate([o[2] for o in outs], axis=0)
         payload = {"commit": commit_host.tobytes().hex()}
         if self.key_type == "secp256k1":
             pre = self.pre
@@ -408,6 +446,7 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
         min_paillier_bits: int = MIN_PAILLIER_BITS,
         old_epoch: int = 0,
         rng=None,
+        cohorts: Optional[int] = None,
     ):
         import secrets as _secrets
 
@@ -440,6 +479,7 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
         self.old_pubs = [bytes(p) for p in old_public_keys]
         if key_type == "secp256k1" and self.is_new and preparams is None:
             raise ValueError("secp256k1 reshare requires preparams (new member)")
+        self._plan = pl.CohortPlan.for_batch(self.B, cohorts)
         self._stage = 0
         self._confirm_sent = False
 
@@ -469,11 +509,36 @@ class BatchedReshareParty(_DealingMixin, PartyBase):
                 self.rng.token_bytes(self.B * 32), dtype=np.uint8
             ).reshape(self.B, 32)
         )
-        self._pts, self._block, commit = _blk_deal_commit(
-            self._coeffs, self._blind, self._bind_row(self.self_id),
-            self.key_type,
+        # counter-phase dealing, same transcript discipline as the DKG
+        # party: secrets drawn full-batch above, cohorts only slice
+        bind = self._bind_row(self.self_id)
+
+        def make_job(ci: int, sl: slice):
+            def job():
+                pts, block, commit = _blk_deal_commit(
+                    self._coeffs[:, sl], self._blind[sl], bind[sl],
+                    self.key_type,
+                )
+                commit_host = yield (
+                    "commit_egress",
+                    lambda: np.asarray(commit),  # mpcflow: host-ok — commitment block leaves device for wire serialization
+                )
+                return pts, block, commit_host
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
         )
-        commit_host = np.asarray(commit)  # mpcflow: host-ok — commitment block leaves device for wire serialization
+        self._pts = [
+            _concat_pts([o[0][k] for o in outs]) for k in range(self.tp1)
+        ]
+        self._block = (
+            outs[0][1]
+            if self._plan.serial
+            else jnp.concatenate([o[1] for o in outs], axis=0)
+        )
+        commit_host = np.concatenate([o[2] for o in outs], axis=0)
         commit_hex = commit_host.tobytes().hex()  # mpcflow: declassified — hash commitment, protocol-public
         return [
             self.broadcast(RS_R1, {"commit": commit_hex})

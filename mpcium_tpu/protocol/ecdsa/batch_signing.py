@@ -39,7 +39,7 @@ builds one modulus context per party.
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -51,6 +51,7 @@ from ...core import secp256k1_jax as sp
 from ...core.bignum import P256
 from ...core.paillier import PaillierPrivateKey, PreParams
 from ...engine import gg18_batch as gb
+from ...engine import pipeline as pl
 from ...ops.paillier_mxu import RAND_BITS
 from ...perf import compile_watch
 from ..base import (BatchBlockMixin, KeygenShare, PartyBase, ProtocolError,
@@ -131,6 +132,7 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
         digests: Sequence[bytes],
         dom: gb.Domains = gb.Domains(),
         rng=None,
+        cohorts: Optional[int] = None,
     ):
         import secrets as _secrets
 
@@ -240,6 +242,10 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
         self.m = self.ring.reduce(
             bn.bytes_to_limbs_le(jnp.asarray(digs[:, ::-1].copy()), P256, 22)
         )
+        # counter-phase cohort geometry for the finalize round (the nine
+        # wire rounds stay full-batch: their proofs/rng draws are ordered
+        # per peer, and the wire transcript must not depend on K)
+        self._plan = pl.CohortPlan.for_batch(self.B, cohorts)
         self._stage = 0
 
     # -- serialization helpers ----------------------------------------------
@@ -644,13 +650,33 @@ class BatchedECDSASigningParty(BatchBlockMixin, PartyBase):
             s = self.ring.addmod(
                 s, self._parse_scalar(self._round_payloads(R9)[j]["s"], j)
             )
-        ok_f, s, rec = gb._blk_final(s, self.m, self._r, self.Y, self._rec)
-        ok = self._ok & ok_f
+
+        # combine + verify as the engine's DONATED round step, cohorted:
+        # cohort A's signature egress (host byte packing) overlaps cohort
+        # B's _step_final dispatch (engine/pipeline counter-phase model)
+        def make_job(ci: int, sl: slice):
+            def job():
+                st = {
+                    "s": s[sl], "m": self.m[sl], "r": self._r[sl],
+                    "rec": self._rec[sl], "ok": self._ok[sl],
+                }
+                st = gb._step_final(st, gb._slice_pt(self.Y, sl))
+                egress = yield (
+                    "sig_egress",
+                    lambda: gb._sig_egress(
+                        st["r"], st["s"], st["rec"], st["ok"]
+                    ),
+                )
+                return egress
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
+        )
         self.result = {
-            "r": np.asarray(sp.pack_be_32(self._r)),  # mpcflow: host-ok — signature egress
-            "s": np.asarray(sp.pack_be_32(s)),  # mpcflow: host-ok — signature egress
-            "recovery": np.asarray(rec),  # mpcflow: host-ok — signature egress
-            "ok": np.asarray(ok),  # mpcflow: host-ok — per-wallet verdicts, egress with the signatures
+            key: pl.merge_rows([o[key] for o in outs])
+            for key in ("r", "s", "recovery", "ok")
         }
         self.done = True
         compile_watch.finish(self._cw)

@@ -25,14 +25,16 @@ culprit attributed (same abort semantics as the per-session protocol).
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...core import bignum as bn
 from ...core import hostmath as hm
 from ...engine import eddsa_batch as eb
+from ...engine import pipeline as pl
 from ...perf import compile_watch
 from ...utils import tracing
 from ..base import KeygenShare, PartyBase, ProtocolError, RoundMsg, party_xs
@@ -46,6 +48,14 @@ def _block_commit(blind: bytes, block: bytes, bind: bytes) -> str:
     return hashlib.sha256(
         b"mpcium-tpu/bsign/" + bind + blind + block
     ).hexdigest()
+
+
+def _span_sync(tensors) -> None:
+    """Materialize a cohort's device-phase result before its span closes
+    so the interval is honest device time — only when tracing is armed
+    (untraced runs never sync here; engine PhaseTimer discipline)."""
+    if tracing.enabled():
+        jax.block_until_ready(tensors)  # mpcflow: host-ok — trace instrumentation, only when tracing is armed
 
 
 class BatchedEDDSASigningParty(PartyBase):
@@ -66,10 +76,12 @@ class BatchedEDDSASigningParty(PartyBase):
         shares: Sequence[KeygenShare],
         messages: Sequence[bytes],
         rng=None,
+        cohorts: Optional[int] = None,
     ):
         import secrets as _secrets
 
         super().__init__(session_id, self_id, party_ids, rng or _secrets)
+        self._cohorts = cohorts
         if len(shares) != len(messages) or not shares:
             raise ValueError("one share per message required")
         self.B = len(shares)
@@ -107,13 +119,36 @@ class BatchedEDDSASigningParty(PartyBase):
         B, q = self.B, len(self.party_ids)
         # mpcshape: unbounded-ok — B is pow-2 snapped upstream (scheduler chunks via engine/buckets.floor_bucket; bench via bucket_b)
         self._cw = compile_watch.begin("party.eddsa", f"B{B}|q{q}")
-        # device-phase spans: each heavy round materializes its result to
-        # host bytes before the span closes, so the interval is honest
-        # device time; with tracing off these are the no-op singleton
-        with tracing.span("phase:bsign_nonce_commit", batch=self.B):
-            r64 = eb.fresh_nonce_bytes(self.B, self.rng)
-            self._r_limbs, R_comp = eb.nonce_commitments(eb.to_dev(r64))
-            self._R_block = np.asarray(R_comp).tobytes()  # B·32 bytes
+        # counter-phase cohort schedule (engine/pipeline): nonces for the
+        # FULL batch are drawn first in K=1 serial order, then row-sliced
+        # per cohort, so wire blocks are bit-identical for every K
+        self._plan = pl.CohortPlan.for_batch(B, self._cohorts)
+        r64 = eb.fresh_nonce_bytes(self.B, self.rng)
+
+        # device-phase spans: each cohort's round syncs its result before
+        # the span closes (only when traced), so the interval is honest
+        # device time; byte packing runs as a host:* pipeline stage
+        def make_job(ci: int, sl: slice):
+            def job():
+                with tracing.span(
+                    "phase:bsign_nonce_commit",
+                    batch=sl.stop - sl.start, cohort=ci,
+                ):
+                    r_limbs, R_comp = eb.nonce_commitments(eb.to_dev(r64[sl]))
+                    _span_sync(R_comp)
+                block = yield (
+                    "nonce_egress",
+                    lambda: np.asarray(R_comp).tobytes(),
+                )
+                return r_limbs, block
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
+        )
+        self._r_limbs_c = [r for r, _ in outs]
+        self._R_block = b"".join(blk for _, blk in outs)  # B·32 bytes
         self._blind = self.rng.token_bytes(32)
         commit = _block_commit(self._blind, self._R_block, self._bind())
         self._stage = 1
@@ -171,41 +206,93 @@ class BatchedEDDSASigningParty(PartyBase):
         R_all = np.stack(
             [np.frombuffer(b, dtype=np.uint8).reshape(self.B, 32) for b in R_blocks]
         )
-        with tracing.span("phase:bsign_aggregate_partial", batch=self.B):
-            R_sum, ok_R = eb.aggregate_nonce(eb.to_dev(R_all, axis=1))
-            self._R_sum = np.asarray(R_sum)
-            self._ok_R = np.asarray(ok_R)
-            self._c64 = eb.challenge_hashes(
-                self._R_sum, self.A_comp, self.messages
-            )
-            parts = eb.partial_signature(
-                self._r_limbs, eb.to_dev(self._c64), eb.to_dev(self.lamx)
-            )
-            s_block = np.asarray(
-                bn.limbs_to_bytes_le(parts, bn.P256, 32)
-            )
-        self._parts = parts
+
+        def make_job(ci: int, sl: slice):
+            def job():
+                with tracing.span(
+                    "phase:bsign_aggregate_partial",
+                    batch=sl.stop - sl.start, cohort=ci,
+                ):
+                    R_sum, ok_R = eb.aggregate_nonce(
+                        eb.to_dev(R_all[:, sl], axis=1)
+                    )
+                    R_sum_h = np.asarray(R_sum)  # mpcflow: host-ok — R enters the host challenge hash
+                    c64 = eb.challenge_hashes(
+                        R_sum_h, self.A_comp[sl], self.messages[sl]
+                    )
+                    parts = eb.partial_signature(
+                        self._r_limbs_c[ci], eb.to_dev(c64),
+                        eb.to_dev(self.lamx[sl]),
+                    )
+                    _span_sync(parts)
+                egress = yield (
+                    "partial_egress",
+                    lambda: (
+                        np.asarray(bn.limbs_to_bytes_le(parts, bn.P256, 32)),
+                        np.asarray(ok_R),
+                    ),
+                )
+                return R_sum_h, np.asarray(c64), parts, egress
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
+        )
+        self._R_sum = pl.merge_rows([o[0] for o in outs])
+        self._c64 = pl.merge_rows([o[1] for o in outs])
+        self._parts_c = [o[2] for o in outs]
+        self._ok_R = pl.merge_rows([o[3][1] for o in outs])
+        s_block = pl.merge_rows([o[3][0] for o in outs])
         return self.broadcast(R3_PARTIAL, {"s": s_block.tobytes().hex()})
 
     def _finalize(self) -> None:
         blocks = self._peer_blocks(R3_PARTIAL, "s", self.B * 32)
-        stacked = [self._parts]
-        for pid in self.party_ids:
-            if pid == self.self_id:
-                continue
-            arr = np.frombuffer(blocks[pid], dtype=np.uint8).reshape(self.B, 32)
-            stacked.append(
-                bn.bytes_to_limbs_le(jnp.asarray(arr), bn.P256, bn.P256.n_limbs)
-            )
-        parts = jnp.stack(stacked)
-        with tracing.span("phase:bsign_combine_verify", batch=self.B):
-            sigs, _s = eb.combine_signatures(parts, eb.to_dev(self._R_sum))
-            ok = eb.verify_signatures(
-                sigs, eb.to_dev(self.A_comp), eb.to_dev(self._c64)
-            )
-            self.result = {
-                "signatures": np.asarray(sigs),
-                "ok": np.asarray(ok) & self._ok_R,
-            }
+        peer_rows = {
+            pid: np.frombuffer(blocks[pid], dtype=np.uint8).reshape(self.B, 32)
+            for pid in self.party_ids
+            if pid != self.self_id
+        }
+
+        def make_job(ci: int, sl: slice):
+            def job():
+                with tracing.span(
+                    "phase:bsign_combine_verify",
+                    batch=sl.stop - sl.start, cohort=ci,
+                ):
+                    stacked = [self._parts_c[ci]]
+                    for pid in self.party_ids:
+                        if pid == self.self_id:
+                            continue
+                        stacked.append(
+                            bn.bytes_to_limbs_le(
+                                jnp.asarray(peer_rows[pid][sl]),
+                                bn.P256, bn.P256.n_limbs,
+                            )
+                        )
+                    parts = jnp.stack(stacked)
+                    sigs, _s = eb.combine_signatures(
+                        parts, eb.to_dev(self._R_sum[sl])
+                    )
+                    ok = eb.verify_signatures(
+                        sigs, eb.to_dev(self.A_comp[sl]),
+                        eb.to_dev(self._c64[sl]),
+                    )
+                    _span_sync(ok)
+                egress = yield (
+                    "sig_egress",
+                    lambda: (np.asarray(sigs), np.asarray(ok)),
+                )
+                return egress
+
+            return job
+
+        outs = pl.run_counter_phase(
+            [make_job(ci, sl) for ci, sl in enumerate(self._plan.slices())]
+        )
+        self.result = {
+            "signatures": pl.merge_rows([o[0] for o in outs]),
+            "ok": pl.merge_rows([o[1] for o in outs]) & self._ok_R,
+        }
         self.done = True
         compile_watch.finish(self._cw)

@@ -392,10 +392,12 @@ class PhaseTimer:
 
 def phase_share(spans: List[dict]) -> Dict[str, float]:
     """Fold phase spans back into the bench-table shape: span
-    ``phase:<name>`` -> ``{name: seconds}``, with numeric span attrs
-    flattened as ``<name>_<attr>`` (the OT host/device split). This is
-    how bench.py reproduces its phase-share fields from the trace
-    instead of the old private dict.
+    ``phase:<name>`` -> ``{name: seconds}`` and pipeline host stages
+    ``host:<name>`` -> ``{host_<name>: seconds}``, with numeric span
+    attrs flattened as ``<name>_<attr>`` (the OT host/device split).
+    This is how bench.py reproduces its phase-share fields from the
+    trace instead of the old private dict; without the ``host:`` fold a
+    cohorted run's wire stages would silently vanish from the table.
 
     A run that produced no phase spans (watchdog fallback, engine died
     before its first mark) returns the explicit ``{"no_spans": 0.0}``
@@ -403,9 +405,12 @@ def phase_share(spans: List[dict]) -> Dict[str, float]:
     keys and a reader can tell "nothing measured" from "lost"."""
     out: Dict[str, float] = {}
     for s in spans:
-        if not s["name"].startswith("phase:"):
+        if s["name"].startswith("phase:"):
+            name = s["name"][len("phase:"):]
+        elif s["name"].startswith("host:"):
+            name = "host_" + s["name"][len("host:"):]
+        else:
             continue
-        name = s["name"][len("phase:"):]
         out[name] = out.get(name, 0.0) + (s["t1_ns"] - s["t0_ns"]) / 1e9
         for k, v in s.get("attrs", {}).items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -413,3 +418,40 @@ def phase_share(spans: List[dict]) -> Dict[str, float]:
     if not out:
         return {"no_spans": 0.0}
     return out
+
+
+def device_idle_fraction(spans: List[dict]) -> float:
+    """Fraction of the traced window in which the device had NO
+    ``phase:*`` span open — the idle metric ROADMAP item 4's zero-idle
+    pipeline is judged by.
+
+    The window spans from the first to the last edge over BOTH device
+    (``phase:*``) and pipeline host-stage (``host:*``) spans, so host
+    wire time at the edges counts against the device. Overlapping
+    device spans (counter-phase cohorts) are unioned, not summed —
+    overlap is exactly the effect being measured. Returns 0.0 when no
+    device spans exist (nothing measured ⇒ nothing claimable)."""
+    dev: List[tuple] = []
+    lo = hi = None
+    for s in spans:
+        name = s.get("name", "")
+        if not (name.startswith("phase:") or name.startswith("host:")):
+            continue
+        t0, t1 = s["t0_ns"], s["t1_ns"]
+        lo = t0 if lo is None else min(lo, t0)
+        hi = t1 if hi is None else max(hi, t1)
+        if name.startswith("phase:"):
+            dev.append((t0, t1))
+    if not dev or hi is None or hi <= lo:
+        return 0.0
+    dev.sort()
+    busy = 0
+    cur0, cur1 = dev[0]
+    for t0, t1 in dev[1:]:
+        if t0 > cur1:
+            busy += cur1 - cur0
+            cur0, cur1 = t0, t1
+        else:
+            cur1 = max(cur1, t1)
+    busy += cur1 - cur0
+    return max(0.0, 1.0 - busy / (hi - lo))

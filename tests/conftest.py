@@ -13,6 +13,14 @@ import os
 # NOTE: the env var alone is overridden by the environment's baked-in
 # jax config ("axon,cpu"), so set the config knob directly too.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Tier-1 runs the SERIAL pipeline path (K=1, the transcript oracle): the
+# production default (K=2 counter-phase cohorts) would double the compile
+# surface of every engine-touching test on this 1-core host and blow the
+# suite budget for zero coverage — cohort scheduling itself is exercised
+# explicitly in tests/test_pipeline.py via the `cohorts=` argument, which
+# overrides this env default, and on the real engines in the slow tier.
+os.environ.setdefault("MPCIUM_PIPELINE_COHORTS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -100,9 +108,10 @@ def no_leaked_nondaemon_threads():
     import time
 
     # process-lifetime singletons are not leaks: the OT pipeline's host
-    # worker pool (mta_ot._host_pool) is created lazily once per process
-    # and lives until interpreter exit by design
-    _SINGLETONS = ("ot-host",)
+    # worker pool (mta_ot._host_pool) and the cohort pipeline's host
+    # worker (engine/pipeline._host_pool) are created lazily once per
+    # process and live until interpreter exit by design
+    _SINGLETONS = ("ot-host", "pipe-host")
 
     baseline = set(threading.enumerate())
     yield

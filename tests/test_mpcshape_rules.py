@@ -41,11 +41,13 @@ def test_bucket_helpers():
     assert floor_bucket(1) == 1
     assert floor_bucket(6) == 4
     assert floor_bucket(8192) == 8192
-    assert floor_bucket(100000) == 8192
+    assert floor_bucket(16384) == 16384
+    assert floor_bucket(100000) == 16384
     assert bucket_b(1) == 1
     assert bucket_b(5) == 8
     assert bucket_b(1024) == 1024
-    assert bucket_b(100000) == 8192  # clamped to the largest bucket
+    assert bucket_b(10000) == 16384
+    assert bucket_b(100000) == 16384  # clamped to the largest bucket
     with pytest.raises(ValueError):
         floor_bucket(0)
     with pytest.raises(ValueError):
@@ -316,7 +318,7 @@ def test_mps905_nonconstant_vmap_axes():
     assert rule_ids(result) == ["MPS905"]
 
 
-def test_mps905_donated_buffer_read_after_call():
+def test_mps906_donated_buffer_read_after_call():
     src = """
     import functools
     import jax
@@ -330,8 +332,49 @@ def test_mps905_donated_buffer_read_after_call():
         return buf + out
     """
     result, _ = sweep(src)
-    assert rule_ids(result) == ["MPS905"]
+    assert rule_ids(result) == ["MPS906"]
     assert result.findings[0].key == "step:buf:donated-reuse"
+
+
+def test_mps906_rebound_round_state_chain_is_clean():
+    # the donated-round-state pattern the pipelined engines use: ``st =
+    # round_step(st)`` re-binds the name at the donating call, so later
+    # reads see the step's output pytree, not the donated buffer
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def round_step(st):
+        return {"x": st["x"] + 1}
+
+    def run(st):
+        st = round_step(st)
+        st = round_step(st)
+        return st["x"]
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == []
+
+
+def test_mps906_read_after_unrebound_donation_still_flags():
+    # assigning the result to a DIFFERENT name leaves the donated
+    # binding live — reading it afterwards is the real bug
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def round_step(st):
+        return {"x": st["x"] + 1}
+
+    def run(st):
+        out = round_step(st)
+        later = st["x"]
+        return out, later
+    """
+    result, _ = sweep(src)
+    assert rule_ids(result) == ["MPS906"]
 
 
 def test_mps905_literal_axes_and_clean_donation_are_fine():

@@ -1,0 +1,389 @@
+"""Counter-phase cohort pipeline (ISSUE 17): the zero-idle round
+schedule must be INVISIBLE everywhere except the clock.
+
+Fast tier: cohort resolution stays on the bucket grid, the stub
+scheduler interleaves and preserves order, host stages surface as
+``host:*`` spans, the idle-fraction math holds on synthetic spans,
+CohortAbort blame survives the split, and the scheduler's
+cohort-aligned manifests are signature-covered and engine-clamped.
+
+Slow tier (the engine-compile policy of test_gg18_batch.py /
+test_eddsa_batch.py): signatures and transcripts are bit-identical for
+K ∈ {1, 2, 4} on real GG18-OT and EdDSA signing at B=8 — cohorting is
+a scheduling choice, never a protocol one.
+"""
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from mpcium_tpu.engine import pipeline as pl
+from mpcium_tpu.engine.abort import CohortAbort
+from mpcium_tpu.engine.buckets import BUCKETS, is_bucket
+from mpcium_tpu.utils import tracing
+
+
+class DetRng:
+    """Deterministic CSPRNG stand-in (test_mta_ot_pipeline.py pattern):
+    a hash-counter stream, so two instances with one seed draw identical
+    bytes in identical call order — the bit-exactness fixture."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.ctr = 0
+
+    def token_bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += hashlib.sha256(
+                b"pipedet|%d|%d" % (self.seed, self.ctr)
+            ).digest()
+            self.ctr += 1
+        return bytes(out[:n])
+
+    def randbelow(self, n: int) -> int:
+        return int.from_bytes(self.token_bytes(40), "big") % n
+
+
+# -- cohort resolution: always on the bucket grid -----------------------------
+
+
+def test_resolve_cohorts_defaults(monkeypatch):
+    # conftest pins the tier-1 suite to K=1; this test is ABOUT the
+    # production default, so clear the pin
+    monkeypatch.delenv(pl.ENV_COHORTS, raising=False)
+    assert pl.resolve_cohorts(1) == 1
+    assert pl.resolve_cohorts(2) == 1  # 2/2 = 1 lane < MIN_COHORT_LANES
+    assert pl.resolve_cohorts(4) == 2
+    assert pl.resolve_cohorts(8) == 2
+    assert pl.resolve_cohorts(16384) == 2
+
+
+def test_resolve_cohorts_explicit_clamps_to_grid():
+    assert pl.resolve_cohorts(8, 1) == 1
+    assert pl.resolve_cohorts(8, 4) == 4
+    assert pl.resolve_cohorts(8, 8) == 4   # 8/8 = 1 lane → halve
+    assert pl.resolve_cohorts(8, 64) == 4  # absurd K from the wire → grid
+    assert pl.resolve_cohorts(4, 4) == 2
+    assert pl.resolve_cohorts(2, 2) == 1
+    assert pl.resolve_cohorts(6, 3) == 1   # non-pow-2 floor + off-grid width
+    with pytest.raises(ValueError):
+        pl.resolve_cohorts(0)
+
+
+def test_resolve_cohorts_env_override(monkeypatch):
+    monkeypatch.setenv(pl.ENV_COHORTS, "4")
+    assert pl.resolve_cohorts(16) == 4
+    monkeypatch.setenv(pl.ENV_COHORTS, "1")
+    assert pl.resolve_cohorts(16) == 1
+    monkeypatch.setenv(pl.ENV_COHORTS, "not-a-number")
+    assert pl.resolve_cohorts(16) == pl.DEFAULT_COHORTS
+
+
+def test_every_bucket_splits_back_onto_the_grid(monkeypatch):
+    """The compile-surface invariant: for every serving bucket B the
+    resolved cohort width B/K is itself a bucket, so a cohorted dispatch
+    reuses a prewarmed compile instead of minting a new signature."""
+    monkeypatch.delenv(pl.ENV_COHORTS, raising=False)
+    for b in BUCKETS:
+        k = pl.resolve_cohorts(b)
+        assert b % k == 0
+        assert k == 1 or is_bucket(b // k)
+        # and for any advertised K, however hostile
+        for adv in (0, 1, 2, 3, 7, 8, 64, 4096):
+            kk = pl.resolve_cohorts(b, adv)
+            assert b % kk == 0
+            assert kk == 1 or is_bucket(b // kk)
+
+
+# -- CohortPlan geometry ------------------------------------------------------
+
+
+def test_plan_slices_and_split():
+    plan = pl.CohortPlan(8, 2)
+    assert plan.width == 4 and not plan.serial
+    assert plan.slices() == [slice(0, 4), slice(4, 8)]
+    arr = np.arange(16).reshape(8, 2)
+    lo, hi = plan.split(arr)
+    assert (np.concatenate([lo, hi]) == arr).all()
+    byaxis = plan.split(arr.T, axis=1)
+    assert (byaxis[1] == arr.T[:, 4:]).all()
+
+
+def test_plan_split_tree_keeps_structure():
+    from typing import NamedTuple
+
+    class Pt(NamedTuple):
+        x: np.ndarray
+        y: np.ndarray
+
+    plan = pl.CohortPlan(4, 2)
+    tree = {"p": Pt(np.arange(4), np.arange(4) * 10), "raw": np.arange(4)}
+    parts = plan.split_tree(tree)
+    assert len(parts) == 2
+    assert isinstance(parts[0]["p"], Pt)
+    assert (parts[1]["p"].y == np.array([20, 30])).all()
+    assert (parts[0]["raw"] == np.array([0, 1])).all()
+
+
+def test_plan_to_global_bounds_checked():
+    plan = pl.CohortPlan(8, 4)
+    assert plan.to_global(0, 0) == 0
+    assert plan.to_global(3, 1) == 7
+    with pytest.raises(ValueError):
+        plan.to_global(1, 2)
+
+
+def test_merge_rows_restores_batch_order():
+    plan = pl.CohortPlan(8, 2)
+    arr = np.arange(24).reshape(8, 3)
+    assert (pl.merge_rows(plan.split(arr)) == arr).all()
+    only = np.arange(3)
+    assert pl.merge_rows([only]) is only
+
+
+# -- counter-phase scheduler --------------------------------------------------
+
+
+def test_run_counter_phase_serial_runs_inline():
+    """K=1 is the transcript oracle: host stages run on the CALLING
+    thread — no worker, no reordering, byte-for-byte the old path."""
+    seen = []
+
+    def job():
+        seen.append(("host-thread", threading.current_thread().name))
+        out = yield ("stage", lambda: threading.current_thread().name)
+        return out
+
+    [res] = pl.run_counter_phase([job])
+    assert res == threading.current_thread().name
+    assert seen[0][1] == threading.current_thread().name
+
+
+def test_run_counter_phase_overlap_results_in_cohort_order():
+    def make_job(ci):
+        def job():
+            a = yield ("first", lambda: ci * 10)
+            b = yield ("second", lambda: a + 1)
+            return (ci, a, b)
+
+        return job
+
+    outs = pl.run_counter_phase([make_job(ci) for ci in range(4)])
+    assert outs == [(ci, ci * 10, ci * 10 + 1) for ci in range(4)]
+
+
+def test_run_counter_phase_host_stages_on_worker_and_interleaved():
+    """K=2: host thunks run on the shared pipe-host worker, and the
+    schedule is counter-phase — cohort 1's first stage is submitted
+    before cohort 0's second (round-robin), so a device dispatch always
+    has a draining host stage to hide behind."""
+    order = []
+
+    def make_job(ci):
+        def job():
+            for stage in ("a", "b"):
+                yield (
+                    f"{stage}{ci}",
+                    lambda s=stage: order.append(
+                        (s, ci, threading.current_thread().name)
+                    ),
+                )
+            return ci
+
+        return job
+
+    outs = pl.run_counter_phase([make_job(ci) for ci in range(2)])
+    assert outs == [0, 1]
+    assert all(name.startswith("pipe-host") for _s, _c, name in order)
+    assert [(s, c) for s, c, _n in order] == [
+        ("a", 0), ("a", 1), ("b", 0), ("b", 1)
+    ]
+
+
+def test_run_counter_phase_emits_host_spans():
+    spans = []
+    tracing.enable(sink=spans.append)
+    try:
+
+        def make_job(ci):
+            def job():
+                yield ("pack_wire", lambda: None)
+                return ci
+
+            return job
+
+        pl.run_counter_phase([make_job(ci) for ci in range(2)])
+    finally:
+        tracing.disable()
+    host = [s for s in spans if s["name"] == "host:pack_wire"]
+    assert len(host) == 2
+    assert sorted(s["attrs"]["cohort"] for s in host) == [0, 1]
+    assert all(s["t1_ns"] >= s["t0_ns"] for s in host)
+
+
+def test_run_counter_phase_propagates_exceptions():
+    def bad():
+        yield ("x", lambda: None)
+        raise CohortAbort([(1, "node-evil", "kos")])
+
+    def good():
+        yield ("y", lambda: None)
+        return "fine"
+
+    with pytest.raises(CohortAbort):
+        pl.run_counter_phase([bad, good])
+
+
+# -- idle-fraction math -------------------------------------------------------
+
+
+def _mkspan(name, t0, t1):
+    return {"name": name, "t0_ns": t0, "t1_ns": t1, "attrs": {}}
+
+
+def test_device_idle_fraction_empty_and_nondevice():
+    assert tracing.device_idle_fraction([]) == 0.0
+    # host stages alone claim nothing: no device span ⇒ nothing claimable
+    assert tracing.device_idle_fraction(
+        [_mkspan("host:pack", 0, 100)]
+    ) == 0.0
+    # unrelated spans are ignored entirely
+    assert tracing.device_idle_fraction(
+        [_mkspan("queue", 0, 100), _mkspan("phase:r1", 0, 50)]
+    ) == 0.0
+
+
+def test_device_idle_fraction_gap_between_rounds():
+    spans = [
+        _mkspan("phase:r1", 0, 40),
+        _mkspan("host:wire", 40, 60),
+        _mkspan("phase:r2", 60, 100),
+    ]
+    # window [0, 100], device busy 80 → idle 0.2 (the serial-path shape)
+    assert tracing.device_idle_fraction(spans) == pytest.approx(0.2)
+
+
+def test_device_idle_fraction_unions_counter_phase_overlap():
+    spans = [
+        _mkspan("phase:r1", 0, 60),      # cohort 0
+        _mkspan("phase:r1", 40, 100),    # cohort 1, overlapping
+        _mkspan("host:wire", 90, 110),   # trailing host stage widens window
+    ]
+    # union busy [0,100] = 100 over window [0,110] → idle 10/110,
+    # NOT (60+60)/110: overlap is the effect being measured, never
+    # double-counted as extra busy time
+    assert tracing.device_idle_fraction(spans) == pytest.approx(10 / 110)
+
+
+# -- abort blame through the split --------------------------------------------
+
+
+def test_remap_abort_names_same_culprits_at_every_k():
+    """A cohort-LOCAL abort remapped through the plan blames the same
+    batch-global (lane, party, check) triples the serial run would."""
+    serial = CohortAbort(
+        [(5, "node-b", "gilboa"), (6, "node-b", "kos")], engine="gg18.sign"
+    )
+    for k in (2, 4):
+        plan = pl.CohortPlan(8, k)
+        # lanes 5 and 6 land in the last cohort (k=2) or cohorts 2/3 (k=4)
+        remapped = []
+        for ci, (lo, hi) in enumerate(plan.bounds):
+            local = [
+                (lane - lo, pid, chk)
+                for lane, pid, chk in serial.culprits
+                if lo <= lane < hi
+            ]
+            if local:
+                err = plan.remap_abort(
+                    CohortAbort(local, engine="gg18.sign"), ci
+                )
+                remapped.extend(err.culprits)
+        assert sorted(remapped) == sorted(serial.culprits)
+        assert err.engine == "gg18.sign"
+
+
+def test_remap_abort_rejects_out_of_cohort_lane():
+    plan = pl.CohortPlan(8, 2)
+    with pytest.raises(ValueError):
+        plan.remap_abort(CohortAbort([(4, "p", "kos")]), 0)
+
+
+# -- scheduler: cohort-aligned manifests --------------------------------------
+
+
+def test_manifest_body_covers_cohorts():
+    """The cohort count rides INSIDE the signed canonical body — a relay
+    cannot flip K without breaking the leader's signature."""
+    from mpcium_tpu.consumers.batch_scheduler import _manifest_body
+
+    a = _manifest_body("b1", "node0", [{"i": 1}], "sign", cohorts=2)
+    b = _manifest_body("b1", "node0", [{"i": 1}], "sign", cohorts=4)
+    assert a != b
+    assert b'"cohorts":2' in a.replace(b" ", b"")
+    # legacy manifests (no cohorts field pre-ISSUE-17) default to serial
+    legacy = _manifest_body("b1", "node0", [{"i": 1}], "sign")
+    assert b'"cohorts":1' in legacy.replace(b" ", b"")
+
+
+def test_advertised_cohorts_are_engine_clamped(monkeypatch):
+    """A leader advertises K but every receiver re-derives it through
+    resolve_cohorts, so a hostile/buggy manifest can never force an
+    off-grid cohort width (a compile signature no one prewarmed)."""
+    monkeypatch.delenv(pl.ENV_COHORTS, raising=False)
+    for n_reqs, advertised in ((8, 64), (8, 3), (2, 2), (5, 4), (16, 0)):
+        k = pl.resolve_cohorts(n_reqs, advertised)
+        assert n_reqs % k == 0
+        width = n_reqs // k
+        assert k == 1 or (width >= pl.MIN_COHORT_LANES and is_bucket(width))
+
+
+# -- slow tier: real engines, bit-identical transcripts across K --------------
+
+
+@pytest.mark.slow
+def test_eddsa_bit_identical_across_cohorts():
+    """B=8 threshold-Ed25519 through the real engine at K ∈ {1, 2, 4}:
+    identical signatures, ok masks, and nonce material — cohorting is
+    pure scheduling."""
+    from mpcium_tpu.engine import eddsa_batch as eb
+
+    B = 8
+    ids = ["n0", "n1", "n2"]
+    shares = eb.dealer_keygen_batch(B, ids, 1, rng=DetRng(3))
+    messages = [DetRng(9).token_bytes(32) for _ in range(B)]
+    outs = {}
+    for k in (1, 2, 4):
+        signer = eb.BatchedCoSigners(ids[:2], shares[:2], rng=DetRng(42))
+        sigs, ok = signer.sign(messages, cohorts=k)
+        assert np.asarray(ok).all(), (k, ok)
+        outs[k] = (np.asarray(sigs).tobytes(), np.asarray(ok).tobytes())
+    assert outs[1] == outs[2] == outs[4]
+
+
+@pytest.mark.slow
+def test_gg18_ot_bit_identical_across_cohorts():
+    """B=8 GG18 with OT-MtA at K ∈ {1, 2, 4}: r, s, recovery and ok are
+    byte-identical — all signing randomness is drawn full-batch in K=1
+    serial order before the cohort split (gg18_batch._finish_sign)."""
+    from mpcium_tpu.engine import gg18_batch as gb
+
+    B = 8
+    ids = ["n0", "n1", "n2"]
+    shares = gb.dealer_keygen_secp_batch(B, ids, 1, rng=DetRng(3))
+    digests = np.frombuffer(
+        DetRng(9).token_bytes(B * 32), dtype=np.uint8
+    ).reshape(B, 32)
+    outs = {}
+    for k in (1, 2, 4):
+        signer = gb.GG18BatchCoSigners(
+            ids[:2], shares[:2], mta_impl="ot", rng=DetRng(42)
+        )
+        out = signer.sign(digests, cohorts=k)
+        assert out["ok"].all(), (k, out["ok"])
+        outs[k] = tuple(
+            out[key].tobytes() for key in ("r", "s", "recovery", "ok")
+        )
+    assert outs[1] == outs[2] == outs[4]

@@ -201,6 +201,24 @@ def test_phase_timer_spans_and_phase_share_roundtrip():
     assert all(s["node"] == "engine" and s["tid"] == "e:B4" for s in spans)
 
 
+def test_phase_share_folds_pipeline_host_stages():
+    """The cohort pipeline's wire stages (``host:<label>`` spans,
+    engine/pipeline._run_host_stage) land in the phase table as
+    ``host_<label>`` — without the fold a cohorted run's wire time would
+    silently vanish from bench.py's table (ISSUE 17)."""
+    spans = []
+    tracing.enable(sink=spans.append)
+    pt = tracing.PhaseTimer("eng", lambda ts: None, node="engine", tid="e:B4")
+    pt.mark("r1")
+    tracing.emit("host:sig_egress", 100, 400, node="engine", cohort=0)
+    tracing.emit("host:sig_egress", 500, 700, node="engine", cohort=1)
+    tracing.emit("queue", 0, 50)  # non-phase spans stay out of the table
+    share = tracing.phase_share(spans)
+    assert set(share) == {"r1", "host_sig_egress", "host_sig_egress_cohort"}
+    # both cohorts' egress stages sum into one table row
+    assert share["host_sig_egress"] == pytest.approx((300 + 200) / 1e9)
+
+
 # -- flight recorder ----------------------------------------------------------
 
 
