@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: install lint check shapecheck warmcheck prewarm trace-check perfcheck perf-tests test test-all bench broker chaos soak soak-tests setup-identities setup-initiator clean
+.PHONY: install lint check shapecheck warmcheck claimscheck prewarm trace-check perfcheck perf-tests test test-all bench tpu-round broker chaos soak soak-tests setup-identities setup-initiator clean
 
 install:
 	pip install -e . --no-build-isolation --no-deps
@@ -42,6 +42,14 @@ shapecheck:
 # no silent gaps — pure stdlib, no jax. Also folded into check_all.
 warmcheck:
 	$(PY) scripts/prewarm.py --check
+
+# claims drift gate alone (OBSERVABILITY.md "Claims & campaigns"): the
+# committed CLAIMS.json/CLAIMS.md must match a fresh evaluation of the
+# artifact corpus — 0 unknown metrics, 0 untracked ROADMAP headlines.
+# Regenerate after adding an artifact or a claim with
+# scripts/claimscheck.py --regen. Also folded into check_all.
+claimscheck:
+	$(PY) scripts/claimscheck.py
 
 # fill the XLA persistent cache for this host's serving set (the same
 # pass the daemon runs at boot with warm_enabled; see scripts/prewarm.py
@@ -88,6 +96,12 @@ test-all:
 
 bench:
 	$(PY) bench.py
+
+# the ROADMAP item-1 round as one resumable command (claims ledger +
+# campaign runner). In a live TPU window: `make tpu-round`; anywhere:
+# `python scripts/tpu_round.py --rehearse` proves the harness on CPU.
+tpu-round:
+	$(PY) scripts/tpu_round.py
 
 # chaos drills (ISSUE 3): the full catalog, JSON reports, non-zero exit
 # on any missed expected outcome; reproduce a failure with --seed

@@ -152,6 +152,9 @@ def _load_last_tpu_record() -> dict | None:
         else:
             then = os.path.getmtime(path)
         rec["age_hours"] = round((time.time() - then) / 3600, 1)
+        # explicit seconds-resolution staleness for the claims engine:
+        # a claim satisfied only by this embedded record is `stale`
+        rec["stale_s"] = round(time.time() - then, 1)
         if "measured_at" not in rec:
             rec["age_hours_is_mtime_guess"] = True
     except Exception:  # noqa: BLE001
@@ -184,6 +187,8 @@ def _arm_watchdog(platform: str) -> None:
             os._exit(0)
         if _STATE["printed"]:
             os._exit(0)
+        from mpcium_tpu.perf.envfp import env_fingerprint
+
         rec = {
             "metric": "secp256k1_2of3_gg18_sigs_per_sec",
             "value": 0.0,
@@ -192,6 +197,8 @@ def _arm_watchdog(platform: str) -> None:
             "platform": platform,
             "watchdog_timeout": True,
             "watchdog_s": deadline,
+            "elapsed_s": round(deadline, 1),
+            "env": env_fingerprint(),
             "stage_reached": _STATE["stage"],
         }
         # loaded at FIRE time, not arm time, so age_hours is current.
@@ -258,6 +265,7 @@ if stood_down() or not parent_alive():
 rec = json.loads(os.environ["MPCIUM_BENCH_FALLBACK"])
 rec["watchdog_timeout"] = True
 rec["watchdog"] = "process"
+rec["elapsed_s"] = round(time.time() - t0, 1)
 sys.stdout.write(json.dumps(rec) + "\n")
 sys.stdout.flush()
 """
@@ -273,12 +281,17 @@ def _arm_process_watchdog(platform: str, deadline: float) -> None:
     process after the fork: it sleeps, checks the sentinel file the
     parent writes after the flagship line, and otherwise emits the
     best-known record itself."""
+    from mpcium_tpu.perf.envfp import env_fingerprint
+
     rec = {
         "metric": "secp256k1_2of3_gg18_sigs_per_sec",
         "value": 0.0,
         "unit": "signatures/sec",
         "vs_baseline": 0.0,
         "platform": platform,
+        # env stamped at ARM time (the child imports nothing from this
+        # repo); the child stamps elapsed_s itself at fire time
+        "env": env_fingerprint(),
         "stage_reached": "unknown (parent frozen in native code)",
     }
     # value stays 0.0 (same contract as the thread watchdog): the cached
@@ -588,40 +601,44 @@ def _b_sweep_entry(bsz: int, timeout_s: float) -> object:
     # sweep points measure the flagship metric only
     env["MPCIUM_BENCH_NO_SECONDARY"] = "1"
     env["MPCIUM_BENCH_NO_OT"] = "1"
+
+    # every DNF shape below is stamped with how long the point ran and
+    # where (env fingerprint): a DNF in the ledger must be attributable
+    # to a host/platform and a timing, not just a reason string
+    from mpcium_tpu.perf.envfp import env_fingerprint
+
+    t0 = time.time()
+
+    def _dnf(reason: str) -> dict:
+        return {
+            "dnf": True,
+            "reason": reason,
+            "elapsed_s": round(time.time() - t0, 1),
+            "env": env_fingerprint(),
+        }
+
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(_HERE, "bench.py")],
             env=env, timeout=timeout_s, capture_output=True,
         )
     except subprocess.TimeoutExpired:
-        return {
-            "dnf": True,
-            "reason": (
-                f"no metric line within {timeout_s:.0f}s — "
-                "killed by sweep driver"
-            ),
-        }
+        return _dnf(
+            f"no metric line within {timeout_s:.0f}s — "
+            "killed by sweep driver"
+        )
     doc = _parse_last_metric_line(r.stdout)
     if doc is None:
-        return {
-            "dnf": True,
-            "reason": f"rc={r.returncode} with no parseable metric line",
-        }
+        return _dnf(f"rc={r.returncode} with no parseable metric line")
     if doc.get("watchdog_timeout"):
-        return {
-            "dnf": True,
-            "reason": (
-                f"watchdog fired at {doc.get('watchdog_s', '?')}s "
-                f"(stage: {doc.get('stage_reached', 'unknown')})"
-            ),
-        }
+        return _dnf(
+            f"watchdog fired at {doc.get('watchdog_s', '?')}s "
+            f"(stage: {doc.get('stage_reached', 'unknown')})"
+        )
     value = doc.get("value")
     if isinstance(value, (int, float)) and value > 0:
         return round(float(value), 3)
-    return {
-        "dnf": True,
-        "reason": f"rc={r.returncode} with non-positive value {value!r}",
-    }
+    return _dnf(f"rc={r.returncode} with non-positive value {value!r}")
 
 
 # Default sweep on TPU when MPCIUM_BENCH_B_SWEEP is unset: the ladder the

@@ -27,9 +27,11 @@ from .envfp import fingerprint_key
 HISTORY_FILE = "PERF_history.jsonl"
 ARTIFACT_GLOBS = (
     "BENCH_r*.json", "BENCH_TPU_*.json", "SOAK_*.json", "MULTICHIP_r*.json",
+    "BENCH_pipeline_*.json", "CAMPAIGN_*.json",
 )
-# scratch outputs that may sit untracked in a working tree
-_EXCLUDE = {"SOAK_local.json"}
+# scratch outputs that may sit untracked in a working tree; the campaign
+# STATE checkpoint is runner bookkeeping, never a measurement artifact
+_EXCLUDE = {"SOAK_local.json", "CAMPAIGN_state.json"}
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
@@ -40,6 +42,11 @@ _CONTEXT_KEYS = (
     "ed25519_batch", "dkg_batch", "reshare_batch", "gg18_ot_mta_batch",
     "gg18_ot_mta_host_s", "gg18_ot_mta_device_s",
     "gg18_ot_mta_overlap_ratio", "gg18_ot_mta_chunks",
+    # checks-on/off A/B (active-security overhead contract, PR 16) and
+    # the span-derived idle meter — claim inputs, never rate metrics
+    "gg18_ot_checks_on_s", "gg18_ot_checks_off_s", "gg18_ot_checks_s",
+    "device_idle_fraction", "gg18_ot_mta_device_idle_fraction",
+    "elapsed_s", "stale_s",
     # bench_ot_host.py --device: host-vs-device hash-suite crossover
     "m_ots", "threads", "cores",
     "ot_host_stage_s", "ot_device_stage_s", "ot_device_stage_speedup",
@@ -84,7 +91,10 @@ def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
     rec["measured_at"] = parsed.get("measured_at")
     value = parsed.get("value")
     if parsed.get("watchdog_timeout"):
-        rec["notes"].append("watchdog fallback record — not a measurement")
+        note = "watchdog fallback record — not a measurement"
+        if isinstance(parsed.get("elapsed_s"), (int, float)):
+            note += f" (fired after {parsed['elapsed_s']:.1f}s)"
+        rec["notes"].append(note)
     metric = parsed.get("metric")
     if metric is not None and isinstance(value, (int, float)):
         rec["metrics"][metric] = float(value)
@@ -107,12 +117,21 @@ def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
             elif isinstance(entry, dict) and entry.get("dnf"):
                 # the structured DNF shape bench.py records:
                 # {"dnf": true, "reason": "..."} — degraded context, never
-                # a metric
+                # a metric. Newer entries also stamp elapsed_s + env, so
+                # the note attributes the DNF to a host and a timing
                 ctx_sweep[bsz] = {"dnf": True}
-                rec["notes"].append(
+                note = (
                     f"b_sweep B={bsz} DNF: "
                     f"{entry.get('reason') or 'no reason recorded'}"
                 )
+                if isinstance(entry.get("elapsed_s"), (int, float)):
+                    note += f" after {entry['elapsed_s']:.1f}s"
+                dnf_env = entry.get("env")
+                if isinstance(dnf_env, dict):
+                    note += (
+                        f" on {fingerprint_key(dnf_env)}"
+                    )
+                rec["notes"].append(note)
             else:
                 # anything else (legacy bare strings) is flagged verbatim
                 # rather than sniffed for substrings
@@ -127,6 +146,18 @@ def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
             rec["notes"].append("no spans recorded (watchdog/DNF run)")
         else:
             rec["context"]["phase_s"] = parsed["phase_s"]
+    # the OT-variant pass records its own phase table; the claims
+    # engine's r2_mta_ot share derives from this one when present
+    if isinstance(parsed.get("gg18_ot_mta_phase_s"), dict) \
+            and parsed["gg18_ot_mta_phase_s"] \
+            and "no_spans" not in parsed["gg18_ot_mta_phase_s"]:
+        rec["context"]["gg18_ot_mta_phase_s"] = parsed["gg18_ot_mta_phase_s"]
+    comp = parsed.get("compile")
+    if isinstance(comp, dict):
+        if isinstance(comp.get("unpredicted"), (int, float)):
+            rec["context"]["compile_unpredicted"] = float(comp["unpredicted"])
+        if isinstance(comp.get("compiles"), (int, float)):
+            rec["context"]["compile_count"] = float(comp["compiles"])
     env = parsed.get("env") if isinstance(parsed.get("env"), dict) else None
     if env:
         rec["env"] = env
@@ -144,6 +175,27 @@ def _normalize_bench_parsed(rec: dict, parsed: dict) -> None:
             "carries cached last_tpu_measurement (degraded-run rider; the "
             "on-chip record is ingested from its own artifact)"
         )
+        rider = parsed["last_tpu_measurement"]
+        if isinstance(rider, dict):
+            # surfaced for the claims engine: a claim satisfied ONLY by
+            # this rider's numbers reads `stale`, never `claimed`
+            rider_metrics = {}
+            rm = rider.get("metric")
+            if rm is not None and isinstance(
+                    rider.get("value"), (int, float)):
+                rider_metrics[rm] = float(rider["value"])
+            for k, v in rider.items():
+                if k.endswith(_RATE_SUFFIXES) and isinstance(
+                        v, (int, float)) and not isinstance(v, bool):
+                    rider_metrics[k] = float(v)
+            stale_s = rider.get("stale_s")
+            if stale_s is None and isinstance(
+                    rider.get("age_hours"), (int, float)):
+                stale_s = round(float(rider["age_hours"]) * 3600.0, 1)
+            rec["context"]["embedded_tpu_rider"] = {
+                "stale_s": stale_s,
+                "metrics": rider_metrics,
+            }
 
 
 def _normalize_bench(source: str, doc: dict) -> dict:
@@ -212,6 +264,78 @@ def _normalize_multichip(source: str, doc: dict) -> dict:
     return rec
 
 
+def _normalize_pipeline(source: str, doc: dict) -> dict:
+    """scripts/bench_pipeline_cpu.py A/B artifact: K-sweep idle
+    fractions are the metrics; bit-identity and the collapse ratio are
+    context."""
+    rec = _base_record(source, "pipeline")
+    for k, v in doc.items():
+        if k.startswith("idle_fraction_k") and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            rec["metrics"][k] = float(v)
+    for k in ("batch", "idle_collapse_ratio"):
+        if isinstance(doc.get(k), (int, float)) \
+                and not isinstance(doc.get(k), bool):
+            rec["context"][k] = doc[k]
+    rec["context"]["signatures_bit_identical"] = bool(
+        doc.get("signatures_bit_identical"))
+    rec["measured_at"] = doc.get("measured_at")
+    env = doc.get("env") if isinstance(doc.get("env"), dict) else None
+    if env:
+        rec["env"] = env
+        rec["platform"] = str(env.get("platform") or "unknown")
+    rec["fingerprint"] = fingerprint_key(env, platform_hint=rec["platform"])
+    rec["degraded"] = (
+        rec["platform"] != "tpu"
+        or not doc.get("signatures_bit_identical")
+    )
+    if rec["platform"] != "tpu":
+        rec["notes"].append(
+            "host-platform pipeline A/B (scheduling proof only) — the "
+            "chip idle collapse is a claims-ledger item"
+        )
+    return rec
+
+
+def _normalize_campaign(source: str, doc: dict) -> dict:
+    """perf/campaign.py report: metrics/context were already lifted by
+    the runner; DNF steps become notes so the history shows exactly
+    which part of a round died."""
+    rec = _base_record(source, "campaign")
+    for k, v in (doc.get("metrics") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            rec["metrics"][k] = float(v)
+    ctx = doc.get("context")
+    if isinstance(ctx, dict):
+        rec["context"].update(ctx)
+    rec["context"]["rehearse"] = bool(doc.get("rehearse"))
+    rec["measured_at"] = doc.get("measured_at")
+    for sid, res in sorted((doc.get("steps") or {}).items()):
+        if isinstance(res, dict) and res.get("dnf"):
+            note = f"step {sid} DNF: {res.get('reason') or 'no reason'}"
+            if isinstance(res.get("elapsed_s"), (int, float)):
+                note += f" after {res['elapsed_s']:.1f}s"
+            rec["notes"].append(note)
+    env = doc.get("env") if isinstance(doc.get("env"), dict) else None
+    if env:
+        rec["env"] = env
+        rec["platform"] = str(env.get("platform") or "unknown")
+    rec["fingerprint"] = fingerprint_key(env, platform_hint=rec["platform"])
+    # a rehearsal is degraded BY DESIGN (it proves the harness, not the
+    # numbers); a live campaign is degraded off-chip or when incomplete
+    rec["degraded"] = (
+        rec["platform"] != "tpu"
+        or bool(doc.get("rehearse"))
+        or not doc.get("complete")
+    )
+    if doc.get("rehearse"):
+        rec["notes"].append(
+            "CPU rehearsal campaign — harness proof, numbers are not "
+            "chip evidence"
+        )
+    return rec
+
+
 def normalize(path: str) -> dict:
     """One committed artifact → one normalized history record. Raises
     on unreadable JSON — an artifact the ledger cannot parse is a gate
@@ -223,6 +347,10 @@ def normalize(path: str) -> dict:
         return _normalize_soak(name, doc)
     if name.startswith("MULTICHIP_"):
         return _normalize_multichip(name, doc)
+    if name.startswith("CAMPAIGN_"):
+        return _normalize_campaign(name, doc)
+    if name.startswith("BENCH_pipeline_"):
+        return _normalize_pipeline(name, doc)
     return _normalize_bench(name, doc)
 
 

@@ -110,6 +110,66 @@ def _multichip_table(records: List[dict]) -> List[str]:
     return lines
 
 
+def _pipeline_table(records: List[dict]) -> List[str]:
+    lines = ["| source | batch | idle K=1 | idle K=2 | idle K=4 | "
+             "bit-identical | platform |",
+             "|---|---|---|---|---|---|---|"]
+    for r in records:
+        m = r["metrics"]
+        lines.append("| " + " | ".join([
+            r["source"], str(r["context"].get("batch", "—")),
+            _fmt(m.get("idle_fraction_k1")), _fmt(m.get("idle_fraction_k2")),
+            _fmt(m.get("idle_fraction_k4")),
+            "yes" if r["context"].get("signatures_bit_identical") else "NO",
+            r["platform"],
+        ]) + " |")
+    return lines
+
+
+def _campaign_table(records: List[dict]) -> List[str]:
+    lines = ["| source | mode | steps | DNF | flagship sigs/s | "
+             "warm boot (s) | notes |",
+             "|---|---|---|---|---|---|---|"]
+    for r in records:
+        m = r["metrics"]
+        lines.append("| " + " | ".join([
+            r["source"],
+            "rehearsal" if r["context"].get("rehearse") else "live",
+            f"{int(m.get('campaign_steps_done', 0))}/"
+            f"{int(m.get('campaign_steps_total', 0))}",
+            str(int(m.get("campaign_steps_dnf", 0))),
+            _fmt(m.get("gg18_ot_mta_sigs_per_sec")
+                 or m.get("secp256k1_2of3_gg18_sigs_per_sec")),
+            _fmt(m.get("warmboot_first_sign_s")),
+            "; ".join(r["notes"]) if r["notes"] else "",
+        ]) + " |")
+    return lines
+
+
+def _claims_section(records: List[dict]) -> List[str]:
+    from . import claims
+
+    evaluated = claims.evaluate(records)
+    s = claims.summary(evaluated)
+    lines = [
+        f"Every ROADMAP-owed headline as a machine-evaluated claim "
+        f"(`mpcium_tpu/perf/claims.py`; full ledger in `CLAIMS.md`): "
+        f"**{s['claimed']} claimed · {s['owed']} owed · "
+        f"{s['stale']} stale.**",
+        "",
+        "| claim | class | status | evidence |",
+        "|---|---|---|---|",
+    ]
+    for c in evaluated:
+        ev = ""
+        if c["evidence"]:
+            ev = f"`{c['evidence']['source']}` → {c['evidence']['value']}"
+        lines.append(
+            f"| {c['id']} | {c['envfp_class']} | {c['status']} | {ev} |"
+        )
+    return lines
+
+
 def render_dashboard(records: List[dict],
                      micro_baseline: Optional[dict] = None) -> str:
     """The committed dashboard, deterministic from its inputs."""
@@ -151,6 +211,17 @@ def render_dashboard(records: List[dict],
     out += ["", "## Multichip dryruns", ""]
     out += (_multichip_table(by_kind["multichip"])
             if by_kind["multichip"] else ["(none)"])
+
+    pipeline = by_kind.get("pipeline") or []
+    out += ["", "## Pipeline idle A/B (counter-phase cohorts)", ""]
+    out += _pipeline_table(pipeline) if pipeline else ["(none)"]
+
+    campaigns = by_kind.get("campaign") or []
+    out += ["", "## Campaigns (scripts/tpu_round.py)", ""]
+    out += _campaign_table(campaigns) if campaigns else ["(none)"]
+
+    out += ["", "## Claims ledger", ""]
+    out += _claims_section(records)
 
     if micro_baseline:
         out += ["", "## Micro-baselines (perfcheck gate)", "",

@@ -18,6 +18,10 @@ compile in seconds on a 1-core CPU host where GG18's secp ladders need
 minutes (test_gg18_batch.py policy); the K-sweep bit-identity of GG18
 itself is tests/test_pipeline.py (slow tier).
 
+With ``--device`` (the campaign's live-window step) the CPU pin is
+skipped so the same A/B runs on whatever chip JAX finds, and ``--k``
+widens the sweep (the owed matrix is K∈{1,2,4} at equal B).
+
 Usage: JAX_PLATFORMS=cpu python scripts/bench_pipeline_cpu.py [--b 8]
 """
 from __future__ import annotations
@@ -29,7 +33,8 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--device" not in sys.argv:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
@@ -94,8 +99,19 @@ def _one_run(ids, shares, messages, k: int):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--b", type=int, default=8, help="batch size (pow-2)")
+    p.add_argument("--k", default="1,2",
+                   help="comma list of cohort counts to A/B (K=1 first)")
+    p.add_argument("--device", action="store_true",
+                   help="skip the CPU pin — run on whatever JAX finds")
+    p.add_argument("--lenient", action="store_true",
+                   help="report but do not fail the idle comparison "
+                        "(rehearsal: sub-ms CPU idle fractions are noise; "
+                        "bit-identity stays a hard failure)")
     p.add_argument("--out", default=os.path.join(_ROOT, OUT_BASENAME))
     args = p.parse_args(argv)
+    ks = sorted({int(x) for x in args.k.split(",") if x.strip()})
+    if 1 not in ks:
+        ks.insert(0, 1)  # K=1 is the serial oracle every K compares to
 
     import jax
 
@@ -115,16 +131,18 @@ def main(argv=None) -> int:
     messages = [DetRng(9).token_bytes(32) for _ in range(B)]
 
     # warm every (K, width) compile signature OUTSIDE the measured runs
-    for k in (1, 2):
+    for k in ks:
         signer = eb.BatchedCoSigners(ids[:2], shares[:2], rng=DetRng(42))
         _sigs, ok = signer.sign(messages, cohorts=k)
         assert ok.all()
 
-    runs = {str(k): _one_run(ids, shares, messages, k) for k in (1, 2)}
+    runs = {str(k): _one_run(ids, shares, messages, k) for k in ks}
 
-    identical = runs["1"]["sig_sha256"] == runs["2"]["sig_sha256"]
+    identical = all(
+        runs[str(k)]["sig_sha256"] == runs["1"]["sig_sha256"] for k in ks
+    )
     idle_1 = runs["1"]["device_idle_fraction"]
-    idle_2 = runs["2"]["device_idle_fraction"]
+    idle_2 = runs["2"]["device_idle_fraction"] if 2 in ks else None
     doc = {
         "comment": (
             "CPU A/B proof of the counter-phase cohort pipeline "
@@ -137,14 +155,20 @@ def main(argv=None) -> int:
         ),
         "engine": "eddsa.sign",
         "batch": B,
+        "cohorts": ks,
         "runs": runs,
         "signatures_bit_identical": identical,
         "idle_fraction_k1": idle_1,
         "idle_fraction_k2": idle_2,
-        "idle_collapse_ratio": round(idle_2 / idle_1, 4) if idle_1 else None,
+        "idle_collapse_ratio": (
+            round(idle_2 / idle_1, 4)
+            if idle_1 and idle_2 is not None else None
+        ),
         "env": env_fingerprint(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
     }
+    for k in ks:
+        doc[f"idle_fraction_k{k}"] = runs[str(k)]["device_idle_fraction"]
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
@@ -152,12 +176,14 @@ def main(argv=None) -> int:
     if not identical:
         print("FAIL: signatures differ across K", file=sys.stderr)
         return 1
-    if not idle_2 < idle_1:
-        print(
-            f"FAIL: K=2 idle {idle_2} not below K=1 idle {idle_1}",
-            file=sys.stderr,
+    if idle_2 is not None and not idle_2 < idle_1:
+        verdict = (
+            f"K=2 idle {idle_2} not below K=1 idle {idle_1}"
         )
-        return 1
+        if not args.lenient:
+            print(f"FAIL: {verdict}", file=sys.stderr)
+            return 1
+        print(f"warn (lenient): {verdict} — idle claim stays owed")
     print(f"ok: idle {idle_1} (K=1) -> {idle_2} (K=2), sigs identical")
     return 0
 

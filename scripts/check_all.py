@@ -101,6 +101,15 @@ def main(argv=None) -> int:
     for prob in warm_problems:
         out.write(f"WARM GAP: {prob}\n")
 
+    # claimscheck off the same pass: the committed CLAIMS.json/CLAIMS.md
+    # must match a fresh evaluation of the artifact corpus, with no
+    # unknown metrics and no ROADMAP headline left untracked
+    from mpcium_tpu.perf import claims
+
+    claims_problems = claims.check_problems(str(_ROOT))
+    for prob in claims_problems:
+        out.write(f"CLAIMS: {prob}\n")
+
     elapsed = time.monotonic() - t0
     out.write(
         f"check_all: {len(files)} files in {elapsed:.2f}s — "
@@ -108,11 +117,13 @@ def main(argv=None) -> int:
         f"{len(stale)} stale, budget "
         f"{'DRIFTED' if drifted else 'in sync'}, surface "
         f"{'DRIFTED' if surface_drifted else 'in sync'}, warm manifest "
-        f"{f'{len(warm_problems)} GAP(S)' if warm_problems else 'covered'}\n"
+        f"{f'{len(warm_problems)} GAP(S)' if warm_problems else 'covered'}, "
+        f"claims "
+        f"{f'{len(claims_problems)} PROBLEM(S)' if claims_problems else 'in sync'}\n"
     )
     return 1 if (
         new or stale or parse_errors or drifted or surface_drifted
-        or warm_problems
+        or warm_problems or claims_problems
     ) else 0
 
 

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Claims drift gate CLI: `make claimscheck`.
+
+Check mode (default): registry hygiene (0 unknown metrics, 0
+silently-untracked ROADMAP headline numbers) plus byte-drift of the
+committed CLAIMS.json / CLAIMS.md against a fresh evaluation of the
+artifact corpus. Exit 0 clean, 1 problems.
+
+--regen: rewrite both renders from the corpus (run after adding an
+artifact or a claim, then review the diff).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from mpcium_tpu.perf import claims, ledger  # noqa: E402
+
+
+def regen() -> int:
+    records = ledger.build_history(_ROOT)
+    problems = claims.registry_problems(records)
+    for prob in problems:
+        print(f"CLAIMS: {prob}")
+    evaluated = claims.evaluate(records)
+    for basename, text in ((claims.CLAIMS_JSON,
+                            claims.render_json(evaluated)),
+                           (claims.CLAIMS_MD, claims.render_md(evaluated))):
+        with open(os.path.join(_ROOT, basename), "w") as f:
+            f.write(text)
+        print(f"wrote {basename}")
+    s = claims.summary(evaluated)
+    print(f"claims: {s['claimed']} claimed, {s['owed']} owed, "
+          f"{s['stale']} stale")
+    return 1 if problems else 0
+
+
+def check() -> int:
+    problems = claims.check_problems(_ROOT)
+    for prob in problems:
+        print(f"CLAIMS: {prob}")
+    s = claims.summary(claims.evaluate(ledger.build_history(_ROOT)))
+    print(f"claimscheck: {s['claimed']} claimed, {s['owed']} owed, "
+          f"{s['stale']} stale — "
+          f"{'%d problem(s)' % len(problems) if problems else 'clean'}")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--regen", action="store_true",
+                   help="rewrite CLAIMS.json/CLAIMS.md from the corpus")
+    args = p.parse_args(argv)
+    return regen() if args.regen else check()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,275 @@
+"""mpcclaims: the claims ledger (ISSUE 19).
+
+Registry hygiene, the predicate engine, the structural guarantees (a
+CPU-degraded record can never satisfy a chip claim; an embedded stale
+rider yields `stale`, never `claimed`), and the drift gate over the
+committed CLAIMS.json / CLAIMS.md — the tier-1 half of `make
+claimscheck`."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mpcium_tpu.perf import claims, ledger
+
+pytestmark = pytest.mark.perf
+
+_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _chip_record(**over):
+    rec = {
+        "source": "BENCH_TPU_X.json", "kind": "bench", "round": None,
+        "platform": "tpu", "degraded": False, "fingerprint": "tpu/abc",
+        "metrics": {}, "context": {}, "measured_at": "2026-08-07T00:00:00",
+        "notes": [],
+    }
+    rec.update(over)
+    return rec
+
+
+# -- predicate engine ---------------------------------------------------------
+
+
+def test_predicate_ops():
+    rec = {"metrics": {"x": 5.0, "y": 10.0}, "context": {}}
+    assert claims.eval_predicate({"op": "gt", "value": 4}, rec, 5.0)
+    assert not claims.eval_predicate({"op": "gt", "value": 5}, rec, 5.0)
+    assert claims.eval_predicate({"op": "ge", "value": 5}, rec, 5.0)
+    assert claims.eval_predicate({"op": "lt", "value": 6}, rec, 5.0)
+    assert claims.eval_predicate({"op": "eq", "value": 5}, rec, 5.0)
+    assert claims.eval_predicate({"op": "exists"}, rec, 0.0)
+    # unresolvable values never satisfy anything — including exists
+    assert not claims.eval_predicate({"op": "exists"}, rec, None)
+    # cross-metric comparison reads the SAME record
+    assert claims.eval_predicate(
+        {"op": "lt_metric", "metric": "y"}, rec, 5.0)
+    assert not claims.eval_predicate(
+        {"op": "lt_metric", "metric": "x"}, rec, 5.0)
+    with pytest.raises(ValueError):
+        claims.eval_predicate({"op": "spaceship"}, rec, 1.0)
+
+
+def test_record_value_forms():
+    rec = {
+        "metrics": {"rate": 7.5},
+        "context": {
+            "gg18_ot_checks_s": 1.25,
+            "phase_s": {
+                "r1_commit_encrypt_rangeproof": 10.0, "r2_mta_ot": 30.0,
+                "r2_mta_respond": 20.0, "r3_verify_decrypt": 30.0,
+                "r4_R_reconstruct_pok": 5.0, "r5_phase5_combine_verify": 5.0,
+            },
+        },
+    }
+    assert claims.record_value(rec, "rate") == 7.5
+    assert claims.record_value(rec, "ctx:gg18_ot_checks_s") == 1.25
+    assert claims.record_value(rec, "missing") is None
+    # derived share: 30 / 100, from the six primary phases only
+    assert claims.record_value(
+        rec, "derived:r2_mta_ot_phase_share") == pytest.approx(0.30)
+
+
+def test_phase_share_prefers_ot_table_and_ignores_attr_keys():
+    # flattened span attrs (_chunks, _overlap_ratio) and device sub-spans
+    # must not pollute the time denominator
+    rec = {"metrics": {}, "context": {
+        "phase_s": {"r2_mta_ot": 99.0, "r2_mta_respond": 1.0},
+        "gg18_ot_mta_phase_s": {
+            "r1_commit_encrypt_rangeproof": 10.0, "r2_mta_ot": 40.0,
+            "r2_mta_respond": 50.0,
+            "r2_mta_ot_chunks": 8.0, "r2_mta_ot_overlap_ratio": 0.9,
+        },
+    }}
+    assert claims.record_value(
+        rec, "derived:r2_mta_ot_phase_share") == pytest.approx(0.40)
+
+
+# -- structural guarantees ----------------------------------------------------
+
+
+def _find(evaluated, claim_id):
+    return next(c for c in evaluated if c["id"] == claim_id)
+
+
+def test_degraded_record_cannot_satisfy_chip_claim():
+    """The r05 failure mode, made structurally impossible: a CPU record
+    carrying a huge number still leaves the chip claim owed."""
+    cpu = _chip_record(
+        platform="cpu", degraded=True,
+        metrics={"ed25519_2of3_sigs_per_sec": 999999.0},
+    )
+    ev = claims.evaluate([cpu])
+    assert _find(ev, "ed25519-10k")["status"] == "owed"
+    # the same number on a non-degraded chip record claims it
+    chip = _chip_record(metrics={"ed25519_2of3_sigs_per_sec": 999999.0})
+    ev = claims.evaluate([chip])
+    c = _find(ev, "ed25519-10k")
+    assert c["status"] == "claimed"
+    assert c["evidence"]["source"] == "BENCH_TPU_X.json"
+
+
+def test_watchdog_zero_record_cannot_claim():
+    wd = _chip_record(degraded=True,
+                      metrics={"b_sweep_16384_sigs_per_sec": 50.0})
+    assert _find(claims.evaluate([wd]), "b-sweep-16384")["status"] == "owed"
+
+
+def test_embedded_stale_rider_yields_stale_never_claimed():
+    """A degraded run whose cached last_tpu_measurement rider would pass
+    the predicate lands as `stale` with the rider's age in evidence."""
+    degraded = _chip_record(
+        platform="cpu", degraded=True,
+        context={"embedded_tpu_rider": {
+            "stale_s": 40000.0,
+            "metrics": {"ed25519_2of3_sigs_per_sec": 12000.0},
+        }},
+    )
+    c = _find(claims.evaluate([degraded]), "ed25519-10k")
+    assert c["status"] == "stale"
+    assert c["evidence"]["stale_s"] == 40000.0
+    assert "rider" in c["evidence"]["note"]
+
+
+def test_requires_gates_which_records_testify():
+    """The phase-share claim only counts runs with device=True OT spans
+    (ctx gg18_ot_mta_device_s > 0) — a pre-device trace at 40% share
+    must not claim it."""
+    table = {
+        "r1_commit_encrypt_rangeproof": 10.0, "r2_mta_ot": 40.0,
+        "r2_mta_respond": 50.0,
+    }
+    no_device = _chip_record(context={"gg18_ot_mta_phase_s": table})
+    ev = claims.evaluate([no_device])
+    assert _find(ev, "r2-mta-ot-phase-share")["status"] == "owed"
+    with_device = _chip_record(context={
+        "gg18_ot_mta_phase_s": table, "gg18_ot_mta_device_s": 3.0,
+    })
+    ev = claims.evaluate([with_device])
+    assert _find(ev, "r2-mta-ot-phase-share")["status"] == "claimed"
+
+
+def test_rehearsal_class_accepts_degraded_records():
+    camp = {
+        "source": "CAMPAIGN_rehearsal.json", "kind": "campaign",
+        "round": None, "platform": "cpu", "degraded": True,
+        "fingerprint": "cpu/x", "metrics": {"campaign_complete": 1.0},
+        "context": {"rehearse": True}, "measured_at": None, "notes": [],
+    }
+    ev = claims.evaluate([camp])
+    assert _find(ev, "campaign-rehearsal-complete")["status"] == "claimed"
+
+
+def test_pipeline_idle_collapse_needs_chip_for_chip_claim():
+    pipe = {
+        "source": "BENCH_pipeline_cpu.json", "kind": "pipeline",
+        "round": None, "platform": "cpu", "degraded": True,
+        "fingerprint": "cpu/x",
+        "metrics": {"idle_fraction_k1": 0.5, "idle_fraction_k2": 0.2},
+        "context": {}, "measured_at": None, "notes": [],
+    }
+    ev = claims.evaluate([pipe])
+    assert _find(ev, "pipeline-idle-collapse")["status"] == "owed"
+    assert _find(ev, "pipeline-idle-collapse-rehearsal")["status"] \
+        == "claimed"
+
+
+# -- registry hygiene + drift gate -------------------------------------------
+
+
+def test_registry_covers_every_roadmap_headline():
+    assert claims.registry_problems([]) == []
+
+
+def test_unknown_metric_is_a_problem(monkeypatch):
+    bogus = dict(claims.REGISTRY[0], id="bogus", metric="no_such_metric_x")
+    monkeypatch.setattr(claims, "REGISTRY", claims.REGISTRY + [bogus])
+    probs = claims.registry_problems([])
+    assert any("unknown metric" in p for p in probs)
+
+
+def test_untracked_headline_is_a_problem(monkeypatch):
+    monkeypatch.setattr(
+        claims, "ROADMAP_HEADLINES",
+        dict(claims.ROADMAP_HEADLINES, brand_new_headline_metric="x"),
+    )
+    probs = claims.registry_problems([])
+    assert any("no claim tracking it" in p for p in probs)
+
+
+def test_committed_claims_match_regeneration():
+    """The drift gate: CLAIMS.json and CLAIMS.md are byte-for-byte pure
+    functions of (registry, committed artifacts)."""
+    records = ledger.build_history(str(_ROOT))
+    evaluated = claims.evaluate(records)
+    assert (_ROOT / claims.CLAIMS_JSON).read_text() \
+        == claims.render_json(evaluated)
+    assert (_ROOT / claims.CLAIMS_MD).read_text() \
+        == claims.render_md(evaluated)
+
+
+def test_claimscheck_cli_green():
+    """`make claimscheck` on the committed tree: clean exit, and every
+    chip headline is machine-evaluated (owed or claimed, never unknown)."""
+    r = subprocess.run(
+        [sys.executable, str(_ROOT / "scripts" / "claimscheck.py")],
+        cwd=str(_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_committed_ledger_has_no_cpu_satisfied_chip_claim():
+    """Acceptance: on the committed corpus no chip claim's evidence is a
+    degraded or stale record — the engine only ever cites live chip
+    records for chip claims."""
+    records = ledger.build_history(str(_ROOT))
+    by_source = {r["source"]: r for r in records}
+    for c in claims.evaluate(records):
+        if c["envfp_class"] != "chip":
+            continue
+        assert c["status"] in ("owed", "claimed", "stale")
+        if c["status"] == "claimed":
+            src = by_source[c["evidence"]["source"]]
+            assert not src["degraded"] and src["platform"] == "tpu"
+
+
+# -- gauges -------------------------------------------------------------------
+
+
+def test_gauge_summary_counts_and_cache(tmp_path):
+    claims.reset_gauge_cache()
+    counts = claims.gauge_summary(str(_ROOT))
+    total = counts["owed"] + counts["claimed"] + counts["stale"]
+    assert total == len(claims.REGISTRY)
+    # unreadable corpus: never raises, flags error
+    bad = tmp_path / "nowhere"
+    bad.mkdir()
+    (bad / "BENCH_r99.json").write_text("{not json")
+    claims.reset_gauge_cache()
+    out = claims.gauge_summary(str(bad))
+    assert out.get("error") == 1
+    claims.reset_gauge_cache()
+
+
+def test_export_gauges_into_registry():
+    from mpcium_tpu.utils.metrics import MetricsRegistry
+
+    claims.reset_gauge_cache()
+    m = MetricsRegistry()
+    counts = claims.export_gauges(m, str(_ROOT))
+    assert m.gauge("claims.owed").value == float(counts["owed"])
+    assert m.gauge("claims.claimed").value == float(counts["claimed"])
+    prom = m.to_prometheus(labels={"node": "n0"})
+    assert "claims_owed" in prom
+
+
+def test_renderers_are_deterministic():
+    records = ledger.build_history(str(_ROOT))
+    ev = claims.evaluate(records)
+    assert claims.render_json(ev) == claims.render_json(ev)
+    doc = json.loads(claims.render_json(ev))
+    assert doc["summary"] == claims.summary(ev)
+    assert len(doc["claims"]) == len(claims.REGISTRY)

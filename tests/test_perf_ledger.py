@@ -30,7 +30,8 @@ def test_discovers_every_committed_artifact():
 def test_every_committed_artifact_normalizes():
     for path in ledger.discover_artifacts(ROOT):
         rec = ledger.normalize(path)  # raises = gate failure
-        assert rec["kind"] in ("bench", "soak", "multichip")
+        assert rec["kind"] in (
+            "bench", "soak", "multichip", "pipeline", "campaign")
         assert rec["fingerprint"], path
         assert isinstance(rec["metrics"], dict)
 

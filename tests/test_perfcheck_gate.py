@@ -5,6 +5,7 @@ on an injected slowdown — proven against a freshly measured
 self-baseline so the assertion holds on any host."""
 import json
 import os
+import subprocess
 import sys
 
 import pytest
@@ -21,8 +22,17 @@ import perfcheck  # noqa: E402
 
 def test_perfcheck_main_passes_on_head():
     # strict on the baseline's host, informational elsewhere — either
-    # way HEAD must exit 0 (this IS the tier-1 regression gate)
-    assert perfcheck.main([]) == 0
+    # way HEAD must exit 0 (this IS the tier-1 regression gate).
+    # Measured in a fresh subprocess so the samples share a process
+    # context with the committed baseline (--update-baseline measures
+    # standalone): hundreds of tests into a shared pytest process the
+    # thread-handoff rows inflate ~2x on a contended 1-core host and
+    # flag regressions in code that did not change.
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "perfcheck.py")],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
 
 
 def test_committed_baseline_exists_and_has_all_benches():
